@@ -1,0 +1,28 @@
+"""psana_ray_tpu — a TPU-native streaming-inference framework.
+
+A brand-new framework with the capability set of the ``psana-ray`` reference
+(sharded experiment ingest -> bounded backpressured transport -> elastic
+compute consumers), re-designed TPU-first: per-host ring buffers feed batched,
+double-buffered ``jax.device_put`` infeed onto a ``jax.sharding.Mesh``, where
+jitted calibration kernels and ``pjit``'d flax models (PeakNet-style U-Net,
+ResNet-50) run with no CUDA device in the loop.
+
+Package layout (reference parity noted per module; see SURVEY.md):
+
+- :mod:`psana_ray_tpu.records`   — versioned frame record + typed EOS marker
+- :mod:`psana_ray_tpu.config`    — single config surface (reference producer.py:17-33)
+- :mod:`psana_ray_tpu.transport` — bounded queues w/ put/get/size semantics
+  (reference shared_queue.py:9-31), registry rendezvous (producer.py:35-71)
+- :mod:`psana_ray_tpu.sources`   — DataSource protocol (producer.py:81,88,150-154)
+- :mod:`psana_ray_tpu.infeed`    — batcher + prefetching host->TPU pipeline
+- :mod:`psana_ray_tpu.ops`       — calibration: pedestal, common-mode, masking
+- :mod:`psana_ray_tpu.models`    — PeakNet-style U-Net, ResNet-50 (flax)
+- :mod:`psana_ray_tpu.parallel`  — mesh/sharding, ring attention, collectives
+- :mod:`psana_ray_tpu.consumer`  — DataReader client (reference data_reader.py)
+- :mod:`psana_ray_tpu.producer`  — producer entry point (reference producer.py)
+"""
+
+__version__ = "0.1.0"
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord  # noqa: F401
+from psana_ray_tpu.config import PipelineConfig  # noqa: F401

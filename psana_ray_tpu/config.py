@@ -1,0 +1,127 @@
+"""Single, layered configuration surface for the whole pipeline.
+
+The reference scatters (mismatched) defaults across three files — producer
+flags ``queue_name='my'`` / ``namespace='default'`` (``producer.py:26-27``),
+``DataReader`` defaults ``queue_name='shared_queue'`` / ``namespace='my'``
+(``data_reader.py:5``), and ``create_queue`` defaults that differ again
+(``shared_queue.py:33``) — so the documented quickstart never rendezvouses
+out of the box (SURVEY.md §3 quirk 3). Here every component reads the same
+dataclasses, and the producer/consumer CLIs parse into them.
+
+Covers all 13 reference flags (``producer.py:17-33``) plus the TPU-specific
+mesh/batch/infeed knobs the reference has no counterpart for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+class RetrievalMode:
+    """Event retrieval mode, parity with psana's ImageRetrievalMode
+    (reference ``producer.py:156-159``): ``calib`` = calibrated panel stack,
+    ``image`` = assembled 2-D image, ``raw`` = uncalibrated ADUs."""
+
+    CALIB = "calib"
+    IMAGE = "image"
+    RAW = "raw"
+
+    ALL = (CALIB, IMAGE, RAW)
+
+
+@dataclasses.dataclass
+class SourceConfig:
+    """What to read. Reference flags: --exp --run --detector_name --calib
+    --max_steps (``producer.py:19-22,30``)."""
+
+    exp: str = "synthetic"
+    run: int = 1
+    detector_name: str = "epix10k2M"
+    mode: str = RetrievalMode.CALIB
+    max_steps: Optional[int] = None
+    # synthetic-source extras (no reference counterpart)
+    num_events: int = 1024
+    seed: int = 0
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.mode not in RetrievalMode.ALL:
+            raise ValueError(f"mode must be one of {RetrievalMode.ALL}, got {self.mode!r}")
+
+
+@dataclasses.dataclass
+class MaskConfig:
+    """Masking. Reference flags: --uses_bad_pixel_mask --manual_mask_path
+    (``producer.py:23-24``); applied as ``np.where(mask, data, 0)``
+    (``producer.py:92-95``)."""
+
+    uses_bad_pixel_mask: bool = False
+    manual_mask_path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransportConfig:
+    """Queue/rendezvous. Reference flags: --ray_address --ray_namespace
+    --queue_name --queue_size --num_consumers (``producer.py:25-29``).
+    ONE set of defaults shared by producer, queue, and consumer."""
+
+    address: str = "auto"
+    namespace: str = "default"
+    queue_name: str = "shared_queue"
+    queue_size: int = 100
+    num_consumers: int = 1
+    # backpressure envelope, parity with producer.py:85-86,108-110
+    backoff_base_s: float = 0.1
+    backoff_cap_s: float = 2.0
+    backoff_jitter_s: float = 0.5
+    # rendezvous retry loop, parity with producer.py:56-67
+    rendezvous_retries: int = 10
+    rendezvous_interval_s: float = 1.0
+    # consumer poll interval when starved (reference hardcodes 1 s,
+    # psana_consumer.py:40 — far too coarse; default 10 ms here)
+    poll_interval_s: float = 0.01
+
+
+@dataclasses.dataclass
+class InfeedConfig:
+    """Host->TPU infeed (no reference counterpart; replaces the per-event
+    blocking RPC of reference producer.py:101 / data_reader.py:35)."""
+
+    batch_size: int = 32
+    prefetch_depth: int = 2
+    compute_dtype: str = "bfloat16"
+    drop_remainder: bool = False  # False => pad + mask the final partial batch
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Device mesh layout for pjit'd consumers. Axes follow the scaling-book
+    convention: data (DP across hosts/chips), model (TP within)."""
+
+    axis_names: Tuple[str, ...] = ("data", "model")
+    # -1 = infer that axis so prod(shape) == device count
+    axis_shape: Tuple[int, ...] = (-1, 1)
+
+
+@dataclasses.dataclass
+class LogConfig:
+    """Reference flag: --log_level (``producer.py:31-32``)."""
+
+    level: str = "INFO"
+    fmt: str = "%(asctime)s - %(levelname)s - %(message)s"
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """Aggregate config: one object, one source of truth."""
+
+    source: SourceConfig = dataclasses.field(default_factory=SourceConfig)
+    mask: MaskConfig = dataclasses.field(default_factory=MaskConfig)
+    transport: TransportConfig = dataclasses.field(default_factory=TransportConfig)
+    infeed: InfeedConfig = dataclasses.field(default_factory=InfeedConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    log: LogConfig = dataclasses.field(default_factory=LogConfig)
+
+    def replace(self, **kw) -> "PipelineConfig":
+        return dataclasses.replace(self, **kw)

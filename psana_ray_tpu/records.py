@@ -1,0 +1,164 @@
+"""Versioned frame record schema and typed end-of-stream marker.
+
+The reference ships a bare 4-list ``[rank, idx, data, photon_energy]``
+(reference ``producer.py:101``) and overloads ``None`` for both "queue empty"
+and "end of stream" (``shared_queue.py:21``, ``producer.py:124-125``), which
+its own example mis-unpacks (``psana_consumer.py:35`` — 3-way unpack of a
+4-list). This module fixes those quirks (SURVEY.md §3 quirks 1-2) with:
+
+- :class:`FrameRecord` — an explicit, versioned record with named fields;
+- :class:`EndOfStream` — a typed EOS marker distinct from "try again";
+- a compact binary wire format for cross-process / cross-host transports.
+
+Everything here is plain Python + numpy so it is importable without JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+# Wire format magics (little-endian u32).
+_FRAME_MAGIC = 0x50525446  # "PRTF" — psana-ray-tpu frame
+_EOS_MAGIC = 0x50525445  # "PRTE" — psana-ray-tpu EOS
+
+# header: magic, version, shard_rank, event_idx, ndim, dtype_code, photon_energy(f64), timestamp(f64)
+_FRAME_HEADER = struct.Struct("<IIqqII d d")
+_EOS_HEADER = struct.Struct("<IIqq")
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int32): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.int16): 5,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FrameRecord:
+    """One detector event.
+
+    Parity with the reference payload ``[rank, idx, data, photon_energy]``
+    (``producer.py:101``), plus schema version and timestamp. ``panels`` is
+    always 3-D ``[P, H, W]`` — 2-D frames are promoted with a leading panel
+    axis exactly like the reference does (``producer.py:96-97``).
+
+    ``eq=False``: dataclass-generated ``__eq__`` would tuple-compare the
+    ndarray field and raise; use :meth:`equals` for value comparison.
+    """
+
+    shard_rank: int
+    event_idx: int
+    panels: np.ndarray  # [P, H, W]
+    photon_energy: float
+    timestamp: float = 0.0
+    schema_version: int = SCHEMA_VERSION
+
+    def __post_init__(self):
+        panels = np.asarray(self.panels)
+        if panels.ndim == 2:
+            panels = panels[None]  # promote, reference producer.py:96-97
+        if panels.ndim != 3:
+            raise ValueError(f"panels must be 2-D or 3-D, got ndim={panels.ndim}")
+        object.__setattr__(self, "panels", panels)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.panels.nbytes)
+
+    def equals(self, other: "FrameRecord") -> bool:
+        return (
+            isinstance(other, FrameRecord)
+            and self.shard_rank == other.shard_rank
+            and self.event_idx == other.event_idx
+            and self.photon_energy == other.photon_energy
+            and np.array_equal(self.panels, other.panels)
+        )
+
+    # -- wire format ------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        panels = np.ascontiguousarray(self.panels)
+        dtype_code = _DTYPE_CODES[panels.dtype]
+        header = _FRAME_HEADER.pack(
+            _FRAME_MAGIC,
+            self.schema_version,
+            self.shard_rank,
+            self.event_idx,
+            panels.ndim,
+            dtype_code,
+            float(self.photon_energy),
+            float(self.timestamp),
+        )
+        shape = struct.pack(f"<{panels.ndim}q", *panels.shape)
+        return header + shape + panels.tobytes()
+
+    @staticmethod
+    def from_bytes(buf: bytes) -> "FrameRecord":
+        magic, version, rank, idx, ndim, dtype_code, energy, ts = _FRAME_HEADER.unpack_from(buf, 0)
+        if magic != _FRAME_MAGIC:
+            raise ValueError(f"bad frame magic {magic:#x}")
+        if version > SCHEMA_VERSION:
+            raise ValueError(f"unsupported schema version {version}")
+        off = _FRAME_HEADER.size
+        shape = struct.unpack_from(f"<{ndim}q", buf, off)
+        off += 8 * ndim
+        if dtype_code not in _CODE_DTYPES:
+            raise ValueError(f"unknown dtype code {dtype_code}")
+        dtype = _CODE_DTYPES[dtype_code]
+        n = int(np.prod(shape)) * dtype.itemsize
+        panels = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)), offset=off).reshape(shape)
+        return FrameRecord(
+            shard_rank=rank,
+            event_idx=idx,
+            panels=panels.copy(),
+            photon_energy=energy,
+            timestamp=ts,
+            schema_version=version,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EndOfStream:
+    """Typed end-of-stream marker.
+
+    Replaces the reference's ``None`` sentinel (``producer.py:124-125``),
+    which was indistinguishable from "queue momentarily empty"
+    (``shared_queue.py:21``). ``producer_rank`` records who signalled;
+    ``total_events`` (when known) lets consumers verify completeness.
+    """
+
+    producer_rank: int = 0
+    total_events: int = -1  # -1 = unknown
+    schema_version: int = SCHEMA_VERSION
+
+    def to_bytes(self) -> bytes:
+        return _EOS_HEADER.pack(_EOS_MAGIC, self.schema_version, self.producer_rank, self.total_events)
+
+    @staticmethod
+    def from_bytes(buf: bytes) -> "EndOfStream":
+        magic, version, rank, total = _EOS_HEADER.unpack_from(buf, 0)
+        if magic != _EOS_MAGIC:
+            raise ValueError(f"bad EOS magic {magic:#x}")
+        return EndOfStream(producer_rank=rank, total_events=total, schema_version=version)
+
+
+def decode(buf: bytes):
+    """Decode a wire message into FrameRecord or EndOfStream."""
+    (magic,) = struct.unpack_from("<I", buf, 0)
+    if magic == _FRAME_MAGIC:
+        return FrameRecord.from_bytes(buf)
+    if magic == _EOS_MAGIC:
+        return EndOfStream.from_bytes(buf)
+    raise ValueError(f"unknown wire magic {magic:#x}")
+
+
+def is_eos(item) -> bool:
+    return isinstance(item, EndOfStream)

@@ -1,0 +1,18 @@
+"""Experiment data sources.
+
+Protocol parity with the reference's external ``PsanaWrapperSmd`` surface
+(``producer.py:81,88,150-154``): construct with (exp, run, detector_name),
+``iter_events(mode)`` yielding ``(data, photon_energy)``, and
+``create_bad_pixel_mask()``. Backends:
+
+- :class:`SyntheticSource` — deterministic synthetic detector frames
+  (epix10k2M, Jungfrau4M, ...) for tests and benchmarks;
+- :class:`ReplaySource` — replay frames from ``.npz`` / ``.npy`` files;
+- :func:`open_source` — dispatch by experiment name, falling through to a
+  real psana wrapper when one is importable on an LCLS host.
+"""
+
+from psana_ray_tpu.sources.base import DataSource, DetectorSpec, DETECTORS  # noqa: F401
+from psana_ray_tpu.sources.synthetic import SyntheticSource  # noqa: F401
+from psana_ray_tpu.sources.replay import ReplaySource  # noqa: F401
+from psana_ray_tpu.sources.base import open_source  # noqa: F401

@@ -1,0 +1,74 @@
+"""Replay source: stream frames from .npz/.npy files.
+
+Gives the framework a file-backed backend (record once on an LCLS host,
+replay anywhere) — a capability the reference lacks entirely (it can only
+run live against XTC data, SURVEY.md §4).
+
+File format: ``.npz`` with arrays ``frames [N,P,H,W]`` (or ``[N,H,W]``),
+optional ``photon_energy [N]``, optional ``bad_pixel_mask [P,H,W]``;
+or a bare ``.npy`` of frames.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from psana_ray_tpu.config import RetrievalMode
+from psana_ray_tpu.sources.base import DETECTORS, shard_indices
+
+
+class ReplaySource:
+    def __init__(
+        self,
+        path: str,
+        detector_name: str = "epix10k2M",
+        shard_rank: int = 0,
+        num_shards: int = 1,
+        start_event: int = 0,
+        **_,
+    ):
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self.path = path
+        self.detector_name = detector_name
+        self.shard_rank = shard_rank
+        self.num_shards = num_shards
+        self.start_event = start_event
+        if path.endswith(".npz"):
+            # npz members decompress lazily on first access; frames stay
+            # backed by the zip until indexed (still one big array on use —
+            # for runs larger than RAM, record as .npy and get true mmap).
+            z = np.load(path)
+            self._frames = z["frames"]
+            self._energy = z["photon_energy"] if "photon_energy" in z else None
+            self._mask = z["bad_pixel_mask"] if "bad_pixel_mask" in z else None
+        else:
+            # mmap: a shard touches only its strided events, never the full
+            # file (10k epix10k2M frames ≈ 86 GB f32 must not load eagerly)
+            self._frames = np.load(path, mmap_mode="r")
+            self._energy = None
+            self._mask = None
+        if self._frames.ndim == 3:  # [N,H,W] -> [N,1,H,W]
+            self._frames = self._frames[:, None]
+
+    @property
+    def num_events(self) -> int:
+        return len(self._frames)
+
+    def create_bad_pixel_mask(self) -> np.ndarray:
+        if self._mask is not None:
+            return self._mask.astype(np.uint8)
+        return np.ones(self._frames.shape[1:], dtype=np.uint8)
+
+    def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
+        idxs = shard_indices(self.num_events, self.shard_rank, self.num_shards)
+        for idx in idxs[idxs >= self.start_event]:
+            e = float(self._energy[idx]) if self._energy is not None else 9.5
+            yield np.asarray(self._frames[int(idx)]), e
+
+    def __len__(self) -> int:
+        idxs = shard_indices(self.num_events, self.shard_rank, self.num_shards)
+        return int((idxs >= self.start_event).sum())

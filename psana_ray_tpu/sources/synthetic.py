@@ -1,0 +1,140 @@
+"""Synthetic detector source: deterministic, shardable, physically plausible.
+
+Stands in for the reference's external ``PsanaWrapperSmd`` (``producer.py:
+150-154``) so every protocol in the framework is testable without LCLS data
+(the reference has no such fake and therefore no tests — SURVEY.md §4).
+
+Frames model an area detector in ADUs: pedestal + Gaussian noise + Poisson
+photon signal with a handful of bright Bragg-like peaks, per-panel common
+mode offset (so the common-mode calibration op has something to remove),
+and a deterministic bad-pixel set. Determinism: every event is generated
+from ``seed ^ hash(exp, run, event_idx)`` so any rank can regenerate any
+event — this also powers checkpoint/resume tests.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from psana_ray_tpu.config import RetrievalMode
+from psana_ray_tpu.sources.base import DETECTORS, DetectorSpec, shard_indices
+
+
+def _stable_seed(exp: str, run: int, base_seed: int) -> int:
+    h = 2166136261
+    for b in f"{exp}/{run}/{base_seed}".encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class SyntheticSource:
+    """Deterministic synthetic frames for one (exp, run, detector) shard."""
+
+    def __init__(
+        self,
+        exp: str = "synthetic",
+        run: int = 1,
+        detector_name: str = "epix10k2M",
+        num_events: int = 1024,
+        seed: int = 0,
+        shard_rank: int = 0,
+        num_shards: int = 1,
+        dtype: str = "float32",
+        peak_count: int = 24,
+        start_event: int = 0,
+    ):
+        if detector_name not in DETECTORS:
+            raise ValueError(f"unknown detector {detector_name!r}; have {sorted(DETECTORS)}")
+        self.exp = exp
+        self.run = run
+        self.spec: DetectorSpec = DETECTORS[detector_name]
+        self.num_events = num_events
+        self.shard_rank = shard_rank
+        self.num_shards = num_shards
+        self.dtype = np.dtype(dtype)
+        self.peak_count = peak_count
+        self.start_event = start_event  # resume cursor (reference has none, SURVEY.md §5)
+        self._seed = _stable_seed(exp, run, seed)
+
+        self._pedestal: Optional[np.ndarray] = None
+        self._gain_map: Optional[np.ndarray] = None
+
+    # -- protocol surface (parity: producer.py:81,88) ---------------------
+    def create_bad_pixel_mask(self) -> np.ndarray:
+        """1 = good pixel, 0 = bad. Deterministic per (exp, run, detector)."""
+        rng = np.random.default_rng(self._seed ^ 0xBAD)
+        mask = rng.random(self.spec.frame_shape) >= self.spec.bad_pixel_fraction
+        return mask.astype(np.uint8)
+
+    def pedestal(self) -> np.ndarray:
+        """Per-pixel pedestal (dark level), for the calibration ops.
+        Constant per source — computed once, cached."""
+        if self._pedestal is None:
+            rng = np.random.default_rng(self._seed ^ 0x9ED)
+            self._pedestal = (
+                self.spec.adu_offset + 3.0 * rng.standard_normal(self.spec.frame_shape)
+            ).astype(np.float32)
+        return self._pedestal
+
+    def gain_map(self) -> np.ndarray:
+        if self._gain_map is None:
+            rng = np.random.default_rng(self._seed ^ 0x6A1)
+            self._gain_map = (
+                1.0 + 0.02 * rng.standard_normal(self.spec.frame_shape)
+            ).astype(np.float32)
+        return self._gain_map
+
+    def event(self, idx: int, mode: str = RetrievalMode.CALIB) -> Tuple[np.ndarray, float]:
+        """Generate event ``idx`` (globally indexed). Deterministic."""
+        rng = np.random.default_rng((self._seed << 20) ^ idx)
+        spec = self.spec
+        p, h, w = spec.frame_shape
+        # photon background (scattering) + readout noise, in photons
+        photons = rng.poisson(0.08, size=(p, h, w)).astype(np.float32)
+        # Bragg-like peaks: a few bright 2-D Gaussians on random panels
+        n_peaks = rng.integers(self.peak_count // 2, self.peak_count + 1)
+        yy = np.arange(h, dtype=np.float32)[:, None]
+        xx = np.arange(w, dtype=np.float32)[None, :]
+        for _ in range(int(n_peaks)):
+            pi = int(rng.integers(0, p))
+            cy, cx = rng.uniform(4, h - 4), rng.uniform(4, w - 4)
+            amp = rng.uniform(50, 800)
+            sig = rng.uniform(0.8, 2.2)
+            photons[pi] += amp * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * sig**2))
+        photon_energy = float(rng.uniform(8.0, 12.0))  # keV
+
+        if mode == RetrievalMode.CALIB:
+            data = photons  # calibrated = photons (what psana calib returns)
+        elif mode == RetrievalMode.RAW:
+            # raw ADUs: pedestal + gain*photons + common-mode per-panel offset + noise
+            cm = rng.uniform(-8.0, 8.0, size=(p, 1, 1)).astype(np.float32)
+            noise = 2.5 * rng.standard_normal((p, h, w)).astype(np.float32)
+            data = self.pedestal() + spec.adu_gain * photons * self.gain_map() + cm + noise
+        elif mode == RetrievalMode.IMAGE:
+            # assembled mosaic: panels tiled into one 2-D image (approximate
+            # geometry — the reference's 'image' mode returns a 2-D array,
+            # promoted to 3-D downstream per producer.py:96-97)
+            cols = max(1, int(np.floor(np.sqrt(p))))
+            rows = (p + cols - 1) // cols
+            img = np.zeros((rows * h, cols * w), dtype=np.float32)
+            for pi in range(p):
+                r, c = divmod(pi, cols)
+                img[r * h : (r + 1) * h, c * w : (c + 1) * w] = photons[pi]
+            data = img
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        return data.astype(self.dtype, copy=False), photon_energy
+
+    def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
+        """Yield this shard's events (parity: producer.py:88)."""
+        for idx in self.shard_event_indices():
+            yield self.event(int(idx), mode)
+
+    def shard_event_indices(self) -> np.ndarray:
+        idxs = shard_indices(self.num_events, self.shard_rank, self.num_shards)
+        return idxs[idxs >= self.start_event]
+
+    def __len__(self) -> int:
+        return len(self.shard_event_indices())

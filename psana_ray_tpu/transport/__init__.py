@@ -1,0 +1,21 @@
+"""Bounded, backpressured transports.
+
+Parity surface (reference ``shared_queue.py:9-31``): ``put(item) -> bool``
+(False when full, never silently drops), ``get() -> item | EMPTY``
+(non-destructive failure), ``size() -> int``. Plus what the reference lacks:
+a typed EOS marker distinct from "empty", blocking variants with timeouts,
+and batched gets for the TPU infeed.
+
+Variants:
+- :class:`RingBuffer` — in-process, thread-safe (unit tests, single-host runs)
+- cross-process shared-memory and cross-host TCP rings live in
+  ``transport.shm_ring`` / ``transport.tcp`` as they land.
+"""
+
+from psana_ray_tpu.transport.ring import EMPTY, FULL, RingBuffer  # noqa: F401
+from psana_ray_tpu.transport.backoff import BackoffPolicy  # noqa: F401
+from psana_ray_tpu.transport.registry import (  # noqa: F401
+    Registry,
+    RendezvousTimeout,
+    TransportClosed,
+)

@@ -1,0 +1,50 @@
+"""Exponential backoff with jitter — producer backpressure policy.
+
+Parity with the reference's envelope (``producer.py:85-86,108-110``):
+base 0.1 s, cap 2.0 s, uniform jitter [0, 0.5) s, retry counter frozen once
+the cap is reached (``producer.py:111``). Parameterized and testable here
+(the reference inlined it in the hot loop)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+
+class BackoffPolicy:
+    def __init__(
+        self,
+        base_s: float = 0.1,
+        cap_s: float = 2.0,
+        jitter_s: float = 0.5,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.jitter_s = jitter_s
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self._retries = 0
+
+    def delay(self) -> float:
+        """Next delay without sleeping (pure; unit-testable)."""
+        d = min(self.cap_s, self.base_s * (2**self._retries))
+        return d + self._rng.uniform(0, self.jitter_s)
+
+    def wait(self) -> float:
+        """Sleep the next delay and advance the counter. Returns the delay."""
+        d = self.delay()
+        self._sleep(d)
+        # stop growing once capped — parity with producer.py:111
+        if self.base_s * (2**self._retries) < self.cap_s:
+            self._retries += 1
+        return d
+
+    def reset(self):
+        self._retries = 0
+
+    @property
+    def retries(self) -> int:
+        return self._retries

@@ -1,0 +1,102 @@
+"""Named-queue rendezvous: the role Ray's GCS actor registry plays in the
+reference.
+
+Reference behavior being reproduced (``producer.py:35-71``):
+- rank 0 get-or-creates the named queue, tolerating the create-vs-get race
+  (``producer.py:42-48``);
+- every participant then resolves the queue by (namespace, name) with a
+  retry loop — 10 retries x 1 s, raising ``TimeoutError`` on exhaustion
+  (``producer.py:56-67``);
+- "detached" lifetime (``shared_queue.py:35``): the queue outlives its
+  creator until explicitly destroyed.
+
+Here the registry is an in-process singleton keyed by (namespace, name); the
+cross-process/cross-host realizations (shm ring files, TCP endpoints) reuse
+the same resolve-with-retry semantics via :func:`Registry.resolve`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class TransportClosed(RuntimeError):
+    """The transport (queue) is dead. Parity role: ``RayActorError`` at the
+    producer (``producer.py:112``) / ``DataReaderError`` at the consumer
+    (``data_reader.py:46-48``)."""
+
+
+class RendezvousTimeout(TimeoutError):
+    """Queue never appeared. Parity: ``producer.py:67``."""
+
+
+class Registry:
+    """Process-wide named-object registry with detached lifetimes."""
+
+    _global: Optional["Registry"] = None
+    _global_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[Tuple[str, str], Any] = {}
+        self._cond = threading.Condition(self._lock)
+
+    @classmethod
+    def default(cls) -> "Registry":
+        with cls._global_lock:
+            if cls._global is None:
+                cls._global = Registry()
+            return cls._global
+
+    @classmethod
+    def reset_default(cls):
+        with cls._global_lock:
+            cls._global = None
+
+    def get_or_create(self, namespace: str, name: str, factory: Callable[[], Any]) -> Any:
+        """Atomic get-or-create — closes the create-vs-get race the reference
+        handles with try-get-first (``producer.py:42-48``)."""
+        with self._lock:
+            key = (namespace, name)
+            if key not in self._objects:
+                self._objects[key] = factory()
+                self._cond.notify_all()
+            return self._objects[key]
+
+    def resolve(
+        self,
+        namespace: str,
+        name: str,
+        retries: int = 10,
+        interval_s: float = 1.0,
+    ) -> Any:
+        """Resolve by name, retrying. Parity: ``producer.py:56-67``.
+
+        Uses a condition wait rather than sleep-loop so in-process resolution
+        is immediate; total timeout is ``retries * interval_s``."""
+        deadline = time.monotonic() + retries * interval_s
+        with self._lock:
+            key = (namespace, name)
+            while key not in self._objects:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RendezvousTimeout(
+                        f"queue {name!r} in namespace {namespace!r} not found "
+                        f"after {retries} x {interval_s}s"
+                    )
+                self._cond.wait(timeout=min(remaining, interval_s))
+            return self._objects[key]
+
+    def destroy(self, namespace: str, name: str):
+        """Explicit teardown — the ``ray stop`` of this world
+        (reference ``README.md:37-40``)."""
+        with self._lock:
+            obj = self._objects.pop((namespace, name), None)
+        if obj is not None and hasattr(obj, "close"):
+            obj.close()
+
+    def list(self, namespace: Optional[str] = None):
+        with self._lock:
+            return [k for k in self._objects if namespace is None or k[0] == namespace]

@@ -1,0 +1,167 @@
+"""In-process bounded ring buffer with the reference queue's semantics.
+
+Reference: ``shared_queue.py`` — a Ray actor wrapping ``collections.deque``
+with non-blocking ``put -> False`` when full (``:11-14``), ``get -> None``
+when empty (``:19-24``), and ``size`` (``:26-31``). Here the same contract is
+an in-process object: the Ray actor serialized all access through one
+process; we serialize through one lock, which is the same guarantee without
+the two cross-node object-store hops of SURVEY.md §3.3.
+
+Improvements over the reference (explicitly, per SURVEY.md §3 quirks):
+- ``get`` returns the typed :data:`EMPTY` sentinel, never a ``None`` that
+  could be confused with data or EOS;
+- blocking ``put``/``get`` with condition variables and timeouts, so callers
+  need not spin-sleep (the reference consumer polls at 1 Hz,
+  ``psana_consumer.py:40``);
+- ``get_batch`` drains up to N items in one lock acquisition — the infeed's
+  building block;
+- ``close()`` wakes all waiters and makes further ops raise
+  :class:`TransportClosed`, giving dead-transport detection parity with the
+  reference's ``RayActorError`` paths (``producer.py:112-114``,
+  ``data_reader.py:36-37``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, List, Optional
+
+from psana_ray_tpu.transport.registry import TransportClosed
+
+
+class _Sentinel:
+    __slots__ = ("_name",)
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __repr__(self):
+        return f"<{self._name}>"
+
+
+EMPTY = _Sentinel("EMPTY")  # queue momentarily empty — try again
+FULL = _Sentinel("FULL")  # queue full — backpressure
+
+
+class RingBuffer:
+    """Thread-safe bounded FIFO with non-blocking and blocking interfaces."""
+
+    def __init__(self, maxsize: int = 100, name: str = "shared_queue"):
+        if maxsize <= 0:
+            raise ValueError("maxsize must be positive")
+        self.maxsize = int(maxsize)
+        self.name = name
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        # lifetime counters (observability the reference lacks, SURVEY.md §5)
+        self._n_put = 0
+        self._n_get = 0
+        self._n_put_rejected = 0
+
+    # -- reference-parity non-blocking surface ---------------------------
+    def put(self, item: Any) -> bool:
+        """Append if not full. Returns False when full (never drops).
+        Parity: ``shared_queue.py:11-14``."""
+        with self._lock:
+            self._check_open()
+            if len(self._q) >= self.maxsize:
+                self._n_put_rejected += 1
+                return False
+            self._q.append(item)
+            self._n_put += 1
+            self._not_empty.notify()
+            return True
+
+    def get(self) -> Any:
+        """Pop the oldest item, or :data:`EMPTY` when none available.
+        Parity: ``shared_queue.py:19-24`` (which returned an ambiguous None)."""
+        with self._lock:
+            self._check_open()
+            if not self._q:
+                return EMPTY
+            item = self._q.popleft()
+            self._n_get += 1
+            self._not_full.notify()
+            return item
+
+    def size(self) -> int:
+        """Current depth. Parity: ``shared_queue.py:26-31``."""
+        with self._lock:
+            return len(self._q)
+
+    # -- blocking variants (new capability) ------------------------------
+    def put_wait(self, item: Any, timeout: Optional[float] = None) -> bool:
+        """Block until space is available (or timeout). Returns success."""
+        with self._not_full:
+            ok = self._not_full.wait_for(
+                lambda: self._closed or len(self._q) < self.maxsize, timeout=timeout
+            )
+            self._check_open()
+            if not ok:
+                return False
+            self._q.append(item)
+            self._n_put += 1
+            self._not_empty.notify()
+            return True
+
+    def get_wait(self, timeout: Optional[float] = None) -> Any:
+        """Block until an item is available (or timeout -> :data:`EMPTY`)."""
+        with self._not_empty:
+            ok = self._not_empty.wait_for(lambda: self._closed or bool(self._q), timeout=timeout)
+            self._check_open()
+            if not ok or not self._q:
+                return EMPTY
+            item = self._q.popleft()
+            self._n_get += 1
+            self._not_full.notify()
+            return item
+
+    def get_batch(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
+        """Drain up to ``max_items`` in one lock acquisition. Blocks for the
+        first item up to ``timeout``; never blocks for subsequent items.
+        The infeed batcher's building block — amortizes synchronization the
+        way the reference's per-event RPC (``data_reader.py:35``) cannot."""
+        with self._not_empty:
+            ok = self._not_empty.wait_for(lambda: self._closed or bool(self._q), timeout=timeout)
+            self._check_open()
+            if not ok:
+                return []
+            n = min(max_items, len(self._q))
+            out = [self._q.popleft() for _ in range(n)]
+            self._n_get += n
+            if n:
+                self._not_full.notify_all()
+            return out
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self):
+        """Mark dead: wake all waiters; further ops raise TransportClosed.
+        Gives consumers/producers the reference's dead-actor detection
+        (``RayActorError`` -> exit, producer.py:112-114) without Ray."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _check_open(self):
+        if self._closed:
+            raise TransportClosed(f"queue {self.name!r} is closed")
+
+    # -- observability ---------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "depth": len(self._q),
+                "maxsize": self.maxsize,
+                "puts": self._n_put,
+                "gets": self._n_get,
+                "puts_rejected": self._n_put_rejected,
+            }

@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference has no tests at all (SURVEY.md §4); this suite follows the
+strategy SURVEY.md prescribes — in-process queue/infeed unit tests plus
+multi-device tests on a CPU-simulated mesh."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    from psana_ray_tpu.transport.registry import Registry
+
+    Registry.reset_default()
+    yield
+    Registry.reset_default()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,63 @@
+"""Record schema: typed EOS, 2-D promotion, wire round-trip.
+
+Covers the reference quirks SURVEY.md §3 items 1-2: sentinel ambiguity and
+payload-schema drift."""
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord, decode, is_eos
+
+
+def test_frame_record_fields():
+    panels = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    rec = FrameRecord(shard_rank=3, event_idx=17, panels=panels, photon_energy=9.5)
+    assert rec.shard_rank == 3
+    assert rec.event_idx == 17
+    assert rec.panels.shape == (2, 3, 4)
+    assert rec.photon_energy == 9.5
+    assert rec.nbytes == 24 * 4
+
+
+def test_2d_promotion():
+    # parity: reference producer.py:96-97 promotes 2-D frames to 3-D
+    rec = FrameRecord(0, 0, np.zeros((5, 6), np.float32), 1.0)
+    assert rec.panels.shape == (1, 5, 6)
+
+
+def test_rejects_bad_ndim():
+    with pytest.raises(ValueError):
+        FrameRecord(0, 0, np.zeros((2, 2, 2, 2), np.float32), 1.0)
+
+
+def test_eos_is_typed_not_none():
+    eos = EndOfStream(producer_rank=0, total_events=100)
+    assert is_eos(eos)
+    assert not is_eos(None)
+    assert not is_eos(FrameRecord(0, 0, np.zeros((1, 2, 2), np.float32), 0.0))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.uint16, np.int32, np.float64])
+def test_wire_roundtrip(dtype):
+    panels = (np.random.default_rng(0).random((4, 8, 8)) * 100).astype(dtype)
+    rec = FrameRecord(1, 42, panels, photon_energy=10.2, timestamp=123.5)
+    out = decode(rec.to_bytes())
+    assert isinstance(out, FrameRecord)
+    assert out.shard_rank == 1 and out.event_idx == 42
+    assert out.photon_energy == pytest.approx(10.2)
+    assert out.timestamp == pytest.approx(123.5)
+    assert out.panels.dtype == dtype
+    np.testing.assert_array_equal(out.panels, panels)
+
+
+def test_eos_wire_roundtrip():
+    eos = EndOfStream(producer_rank=2, total_events=512)
+    out = decode(eos.to_bytes())
+    assert isinstance(out, EndOfStream)
+    assert out.producer_rank == 2
+    assert out.total_events == 512
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode(b"\x00\x00\x00\x00garbage....")

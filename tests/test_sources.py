@@ -1,0 +1,110 @@
+"""Data sources: protocol surface, determinism, sharding, replay."""
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.config import RetrievalMode
+from psana_ray_tpu.sources import DETECTORS, ReplaySource, SyntheticSource, open_source
+from psana_ray_tpu.sources.base import shard_indices
+
+
+# small detector for fast tests
+SMALL = dict(num_events=8, detector_name="epix100")
+
+
+def test_detector_geometries():
+    assert DETECTORS["epix10k2M"].frame_shape == (16, 352, 384)
+    assert DETECTORS["jungfrau4M"].frame_shape == (8, 512, 1024)
+
+
+def test_protocol_surface():
+    src = SyntheticSource(**SMALL)
+    mask = src.create_bad_pixel_mask()
+    assert mask.shape == DETECTORS["epix100"].frame_shape
+    assert mask.dtype == np.uint8
+    events = list(src.iter_events(RetrievalMode.CALIB))
+    assert len(events) == 8
+    data, energy = events[0]
+    assert data.shape == DETECTORS["epix100"].frame_shape
+    assert isinstance(energy, float)
+
+
+def test_determinism():
+    a = SyntheticSource(seed=7, **SMALL).event(3)
+    b = SyntheticSource(seed=7, **SMALL).event(3)
+    np.testing.assert_array_equal(a[0], b[0])
+    assert a[1] == b[1]
+    c = SyntheticSource(seed=8, **SMALL).event(3)
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_shard_indices_disjoint_exhaustive():
+    n, shards = 103, 4
+    all_idx = np.concatenate([shard_indices(n, r, shards) for r in range(shards)])
+    assert sorted(all_idx.tolist()) == list(range(n))
+
+
+def test_sharded_iteration_matches_global():
+    # a rank's events equal the globally-indexed events at its strided indices
+    full = SyntheticSource(num_events=12, detector_name="epix100")
+    rank1 = SyntheticSource(num_events=12, detector_name="epix100", shard_rank=1, num_shards=3)
+    got = [d for d, _ in rank1.iter_events()]
+    want = [full.event(i)[0] for i in (1, 4, 7, 10)]
+    assert len(got) == 4
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_start_event_resume_cursor():
+    src = SyntheticSource(num_events=10, detector_name="epix100", start_event=6)
+    assert list(src.shard_event_indices()) == [6, 7, 8, 9]
+
+
+def test_raw_mode_has_pedestal():
+    src = SyntheticSource(**SMALL)
+    raw, _ = src.event(0, RetrievalMode.RAW)
+    calib, _ = src.event(0, RetrievalMode.CALIB)
+    # raw is in ADUs sitting on a ~100 ADU pedestal; calib is ~0-background photons
+    assert raw.mean() > 50
+    assert abs(float(np.median(calib))) < 1.0
+
+
+def test_image_mode_2d():
+    src = SyntheticSource(**SMALL)
+    img, _ = src.event(0, RetrievalMode.IMAGE)
+    assert img.ndim == 2
+
+
+def test_bad_pixel_fraction():
+    src = SyntheticSource(num_events=1)  # epix10k2M default
+    mask = src.create_bad_pixel_mask()
+    frac_bad = 1.0 - mask.mean()
+    assert 0.001 < frac_bad < 0.006
+
+
+def test_replay_roundtrip(tmp_path):
+    frames = np.random.default_rng(0).random((6, 2, 8, 8)).astype(np.float32)
+    energy = np.linspace(8, 12, 6)
+    path = tmp_path / "run.npz"
+    np.savez(path, frames=frames, photon_energy=energy)
+    src = ReplaySource(str(path))
+    events = list(src.iter_events())
+    assert len(events) == 6
+    np.testing.assert_array_equal(events[2][0], frames[2])
+    assert events[2][1] == pytest.approx(energy[2])
+
+
+def test_replay_sharded(tmp_path):
+    frames = np.zeros((10, 1, 4, 4), np.float32)
+    path = tmp_path / "run.npy"
+    np.save(path, frames)
+    src = ReplaySource(str(path), shard_rank=1, num_shards=4)
+    assert len(src) == len(list(src.iter_events()))
+
+
+def test_open_source_dispatch(tmp_path):
+    assert isinstance(open_source("synthetic", 1, "epix100"), SyntheticSource)
+    np.save(tmp_path / "x.npy", np.zeros((2, 1, 4, 4), np.float32))
+    assert isinstance(open_source(f"replay:{tmp_path}/x.npy", 1, "epix100"), ReplaySource)
+    with pytest.raises(RuntimeError, match="psana"):
+        open_source("mfxl1038923", 58, "epix10k2M")

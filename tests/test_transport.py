@@ -1,0 +1,185 @@
+"""Transport semantics: reference-parity put/get/size, blocking variants,
+close/fault propagation, registry rendezvous, backoff envelope."""
+
+import threading
+import time
+
+import pytest
+
+from psana_ray_tpu.transport import (
+    EMPTY,
+    BackoffPolicy,
+    Registry,
+    RendezvousTimeout,
+    RingBuffer,
+    TransportClosed,
+)
+
+
+class TestRingParity:
+    # semantics of reference shared_queue.py:9-31
+
+    def test_put_get_fifo(self):
+        q = RingBuffer(maxsize=4)
+        assert q.put("a") and q.put("b")
+        assert q.get() == "a"
+        assert q.get() == "b"
+
+    def test_put_full_returns_false_never_drops(self):
+        q = RingBuffer(maxsize=2)
+        assert q.put(1) and q.put(2)
+        assert q.put(3) is False  # parity: shared_queue.py:11-14
+        assert q.size() == 2
+        assert q.get() == 1  # item 3 was NOT enqueued, 1/2 preserved
+
+    def test_get_empty_returns_typed_sentinel(self):
+        q = RingBuffer(maxsize=2)
+        assert q.get() is EMPTY  # not None — fixes quirk 1 (SURVEY.md §3)
+        q.put(None)  # None is valid *data* here, unlike the reference
+        assert q.get() is None
+        assert q.get() is EMPTY
+
+    def test_size(self):
+        q = RingBuffer(maxsize=8)
+        for i in range(5):
+            q.put(i)
+        assert q.size() == 5
+
+
+class TestRingBlocking:
+    def test_get_wait_timeout(self):
+        q = RingBuffer(maxsize=2)
+        t0 = time.monotonic()
+        assert q.get_wait(timeout=0.05) is EMPTY
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_put_wait_unblocks_on_get(self):
+        q = RingBuffer(maxsize=1)
+        q.put("x")
+        done = []
+
+        def producer():
+            done.append(q.put_wait("y", timeout=2.0))
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.05)
+        assert q.get() == "x"
+        t.join(timeout=2.0)
+        assert done == [True]
+        assert q.get() == "y"
+
+    def test_get_batch_drains(self):
+        q = RingBuffer(maxsize=16)
+        for i in range(10):
+            q.put(i)
+        batch = q.get_batch(max_items=8, timeout=0.1)
+        assert batch == list(range(8))
+        assert q.size() == 2
+
+    def test_get_batch_timeout_empty(self):
+        q = RingBuffer(maxsize=4)
+        assert q.get_batch(4, timeout=0.02) == []
+
+
+class TestFaultDetection:
+    # parity role: RayActorError at producer.py:112-114 / data_reader.py:36-37
+
+    def test_ops_raise_after_close(self):
+        q = RingBuffer(maxsize=2)
+        q.put(1)
+        q.close()
+        for op in (lambda: q.put(2), q.get, lambda: q.get_wait(0.01)):
+            with pytest.raises(TransportClosed):
+                op()
+
+    def test_close_wakes_blocked_getter(self):
+        q = RingBuffer(maxsize=2)
+        err = []
+
+        def getter():
+            try:
+                q.get_wait(timeout=5.0)
+            except TransportClosed as e:
+                err.append(e)
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.05)
+        q.close()
+        t.join(timeout=2.0)
+        assert len(err) == 1
+
+
+class TestRegistry:
+    # parity: producer.py:35-71 rendezvous protocol
+
+    def test_get_or_create_idempotent(self):
+        reg = Registry()
+        a = reg.get_or_create("ns", "q", lambda: RingBuffer(4))
+        b = reg.get_or_create("ns", "q", lambda: RingBuffer(8))
+        assert a is b  # second factory ignored — create-vs-get race closed
+
+    def test_resolve_waits_for_creation(self):
+        reg = Registry()
+        out = []
+
+        def resolver():
+            out.append(reg.resolve("ns", "q", retries=10, interval_s=0.1))
+
+        t = threading.Thread(target=resolver)
+        t.start()
+        time.sleep(0.05)
+        q = reg.get_or_create("ns", "q", lambda: RingBuffer(4))
+        t.join(timeout=2.0)
+        assert out == [q]
+
+    def test_resolve_timeout(self):
+        reg = Registry()
+        t0 = time.monotonic()
+        with pytest.raises(RendezvousTimeout):
+            reg.resolve("ns", "missing", retries=3, interval_s=0.02)
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_namespacing(self):
+        reg = Registry()
+        a = reg.get_or_create("ns1", "q", lambda: RingBuffer(4))
+        b = reg.get_or_create("ns2", "q", lambda: RingBuffer(4))
+        assert a is not b
+
+    def test_destroy_closes(self):
+        reg = Registry()
+        q = reg.get_or_create("ns", "q", lambda: RingBuffer(4))
+        reg.destroy("ns", "q")
+        assert q.closed
+        with pytest.raises(RendezvousTimeout):
+            reg.resolve("ns", "q", retries=1, interval_s=0.01)
+
+
+class TestBackoff:
+    # parity envelope: producer.py:85-86,108-111
+
+    def test_delay_growth_and_cap(self):
+        sleeps = []
+        p = BackoffPolicy(base_s=0.1, cap_s=2.0, jitter_s=0.0, sleep=sleeps.append)
+        for _ in range(8):
+            p.wait()
+        assert sleeps[0] == pytest.approx(0.1)
+        assert sleeps[1] == pytest.approx(0.2)
+        assert sleeps[2] == pytest.approx(0.4)
+        assert max(sleeps) <= 2.0
+        assert sleeps[-1] == pytest.approx(2.0)
+
+    def test_jitter_bounds(self):
+        p = BackoffPolicy(base_s=0.1, cap_s=2.0, jitter_s=0.5, sleep=lambda s: None)
+        for _ in range(100):
+            d = p.delay()
+            assert 0.1 <= d <= 2.5
+
+    def test_reset(self):
+        p = BackoffPolicy(sleep=lambda s: None)
+        p.wait()
+        p.wait()
+        assert p.retries == 2
+        p.reset()
+        assert p.retries == 0

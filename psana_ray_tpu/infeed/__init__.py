@@ -1,0 +1,13 @@
+"""Host->TPU infeed: the ⚡ core that replaces the reference's per-event
+blocking RPC (SURVEY.md §3.1 — `ray.get(queue.put.remote(...))` per frame,
+no batching, no prefetch).
+
+Pipeline: transport queue -> :class:`FrameBatcher` (fixed shapes, pad+mask
+partial batches so pjit never recompiles) -> :class:`DevicePrefetcher`
+(double-buffered `jax.device_put` onto the mesh, overlapping host transfer
+with device compute) -> consumer step.
+"""
+
+from psana_ray_tpu.infeed.batcher import Batch, FrameBatcher  # noqa: F401
+from psana_ray_tpu.infeed.pipeline import DevicePrefetcher, InfeedPipeline  # noqa: F401
+from psana_ray_tpu.infeed.multihost import make_global_batch  # noqa: F401

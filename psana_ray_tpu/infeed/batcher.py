@@ -1,0 +1,143 @@
+"""Fixed-shape batching with pad+mask for partial batches.
+
+pjit compiles one program per input shape; a variable-rate stream must
+therefore never present a short batch (SURVEY.md §7 hard part (b)). The
+batcher assembles ``[B, P, H, W]`` stacks; on EOS flush, the tail batch is
+padded to B and a per-row validity mask marks real rows. Metadata
+(shard_rank, event_idx, photon_energy) rides along as arrays so provenance
+survives into the pjit'd world (the reference's `(rank, idx)` stamp,
+``producer.py:101``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord
+
+
+@dataclasses.dataclass
+class Batch:
+    """One fixed-shape batch of frames + aligned metadata.
+
+    ``valid`` marks real rows (padding rows are zeros with valid=0); all
+    arrays have leading dim B regardless of how many events remain.
+    ``num_valid`` is a plain host int (known at assembly time) so consumers
+    never force a device sync just to count rows.
+    """
+
+    frames: np.ndarray  # [B, P, H, W]
+    valid: np.ndarray  # [B] uint8
+    shard_rank: np.ndarray  # [B] int32
+    event_idx: np.ndarray  # [B] int64
+    photon_energy: np.ndarray  # [B] float32
+    num_valid: int = -1
+
+    def __post_init__(self):
+        if self.num_valid < 0:
+            self.num_valid = int(np.asarray(self.valid).sum())
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.frames)
+
+
+class FrameBatcher:
+    """Accumulates FrameRecords into fixed-shape Batches.
+
+    ``push`` returns a completed Batch or None; ``flush`` pads and returns
+    the tail (or None if empty). Frame shape is locked by the first record —
+    a mismatched frame raises (one batcher per detector; multi-detector
+    fan-in uses one batcher per stream, see models/multi-detector configs).
+    """
+
+    def __init__(self, batch_size: int, dtype: Optional[np.dtype] = None):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = batch_size
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self._pending: List[FrameRecord] = []
+        self._frame_shape: Optional[tuple] = None
+
+    def push(self, rec: FrameRecord) -> Optional[Batch]:
+        if self._frame_shape is None:
+            self._frame_shape = rec.panels.shape
+            if self.dtype is None:
+                self.dtype = rec.panels.dtype
+        elif rec.panels.shape != self._frame_shape:
+            raise ValueError(
+                f"frame shape {rec.panels.shape} != locked shape {self._frame_shape}"
+            )
+        self._pending.append(rec)
+        if len(self._pending) == self.batch_size:
+            return self._emit(self._pending)
+        return None
+
+    def flush(self) -> Optional[Batch]:
+        """Pad + emit the tail batch (EOS flush). None when nothing pends."""
+        if not self._pending:
+            return None
+        return self._emit(self._pending)
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def _emit(self, recs: List[FrameRecord]) -> Batch:
+        b = self.batch_size
+        n = len(recs)
+        frames = np.zeros((b, *self._frame_shape), dtype=self.dtype)
+        valid = np.zeros((b,), np.uint8)
+        rank = np.zeros((b,), np.int32)
+        idx = np.zeros((b,), np.int64)
+        energy = np.zeros((b,), np.float32)
+        for i, r in enumerate(recs):
+            frames[i] = r.panels
+            valid[i] = 1
+            rank[i] = r.shard_rank
+            idx[i] = r.event_idx
+            energy[i] = r.photon_energy
+        self._pending = []
+        return Batch(frames, valid, rank, idx, energy, num_valid=n)
+
+
+def batches_from_queue(
+    queue,
+    batch_size: int,
+    poll_interval_s: float = 0.01,
+    max_wait_s: Optional[float] = None,
+) -> Iterator[Batch]:
+    """Drain a transport queue into fixed-shape batches until EOS.
+
+    Uses ``get_batch`` (one lock acquisition for many items) rather than the
+    reference's one-RPC-per-event read (``data_reader.py:35``). On EOS the
+    tail is flushed padded; iteration then stops. ``max_wait_s`` bounds total
+    starvation (None = wait forever, matching the reference consumer loop).
+    """
+    batcher: Optional[FrameBatcher] = None
+    starved_since: Optional[float] = None
+    while True:
+        items = queue.get_batch(batch_size, timeout=poll_interval_s)
+        if not items:
+            now = time.monotonic()
+            starved_since = starved_since if starved_since is not None else now
+            if max_wait_s is not None and now - starved_since >= max_wait_s:
+                if batcher is not None and (tail := batcher.flush()) is not None:
+                    yield tail
+                return
+            continue
+        starved_since = None
+        for item in items:
+            if isinstance(item, EndOfStream):
+                if batcher is not None and (tail := batcher.flush()) is not None:
+                    yield tail
+                return
+            if batcher is None:
+                batcher = FrameBatcher(batch_size)
+            out = batcher.push(item)
+            if out is not None:
+                yield out

@@ -1,0 +1,50 @@
+"""Multi-host global batches: per-host local shards -> one global jax.Array.
+
+The reference's N MPI producer ranks each push into one central queue
+(SURVEY.md §3.3 — every frame makes two network hops). The TPU-native
+topology inverts this: each host ingests only its own shard and the global
+batch exists as a sharded ``jax.Array`` over the pod mesh — device-to-device
+traffic rides ICI inside the pjit'd computation, and no frame ever visits a
+central broker.
+
+``make_global_batch`` wraps ``jax.make_array_from_process_local_data``: on a
+single-host mesh it degenerates to a plain sharded device_put, so the same
+consumer code runs unchanged from laptop CPU mesh to pod."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
+    """Rows of the batch split over the data axis; frames replicated over
+    the model axis (model-parallel consumers see the whole frame)."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def make_global_batch(
+    local_frames: np.ndarray,
+    mesh: Mesh,
+    data_axis: str = "data",
+    global_batch_size: Optional[int] = None,
+) -> jax.Array:
+    """Assemble a global ``[B_global, ...]`` array from this host's local
+    ``[B_local, ...]`` rows.
+
+    Each host calls this with its own shard (uneven tails must be padded to
+    equal B_local host-side first — SURVEY.md §7 hard part (d); the batcher
+    guarantees that). ``global_batch_size`` defaults to
+    ``B_local * process_count``."""
+    sharding = batch_sharding(mesh, data_axis)
+    if jax.process_count() == 1:
+        return jax.device_put(local_frames, sharding)
+    global_shape = (
+        (local_frames.shape[0] * jax.process_count(), *local_frames.shape[1:])
+        if global_batch_size is None
+        else (global_batch_size, *local_frames.shape[1:])
+    )
+    return jax.make_array_from_process_local_data(sharding, local_frames, global_shape)

@@ -1,0 +1,171 @@
+"""Double-buffered device prefetch + the end-to-end infeed pipeline.
+
+The reference's consumer does a blocking cross-node RPC per frame and
+sleeps 1 s when starved (``data_reader.py:35``, ``psana_consumer.py:40``) —
+device compute and host transfer never overlap. Here a background thread
+stages the next ``prefetch_depth`` batches onto the devices while the
+current batch computes, so at steady state the TPU never waits for host
+transfer (the classic double-buffering pattern; depth 2 suffices when
+transfer < compute)."""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from psana_ray_tpu.infeed.batcher import Batch, batches_from_queue
+
+
+class DevicePrefetcher:
+    """Wrap a host Batch iterator; yield device-resident batches.
+
+    ``sharding`` may be a Sharding (placed on a mesh) or None (default
+    device). Transfers run on a background thread ``prefetch_depth`` ahead
+    of consumption; ``jax.device_put`` is async, so the thread's role is to
+    keep the H2D copy stream busy, not to block compute.
+
+    Always ``close()`` (or use as a context manager, or exhaust the
+    iterator) — an abandoned prefetcher would otherwise pin
+    ``prefetch_depth`` device-resident batches and its thread forever."""
+
+    def __init__(
+        self,
+        batches: Iterator[Batch],
+        sharding=None,
+        prefetch_depth: int = 2,
+        to_device: Optional[Callable[[Batch], Any]] = None,
+    ):
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
+        self._src = batches
+        self._sharding = sharding
+        self._buf: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        self._to_device = to_device or self._default_to_device
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _default_to_device(self, batch: Batch):
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        return dataclasses.replace(
+            batch,
+            frames=put(batch.frames),
+            valid=put(batch.valid),
+            shard_rank=put(batch.shard_rank),
+            event_idx=put(batch.event_idx),
+            photon_energy=put(batch.photon_energy),
+            # num_valid stays the host int — counting on-device would sync
+        )
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when close() is called."""
+        while not self._stop.is_set():
+            try:
+                self._buf.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for batch in self._src:
+                if not self._put(self._to_device(batch)):
+                    return  # closed — drop remaining stream
+        except BaseException as e:  # surface in consumer thread
+            self._err = e
+        finally:
+            self._put(None)  # stream end marker (internal)
+
+    def close(self, timeout: float = 5.0):
+        """Stop the prefetch thread and release buffered batches."""
+        self._stop.set()
+        try:
+            while True:
+                self._buf.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=timeout)
+        self._done = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._buf.get()
+        if item is None:
+            self._done = True
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class InfeedPipeline:
+    """transport queue -> batcher -> device prefetch -> step fn.
+
+    The consumer-side analog of the reference's `consume_data` loop
+    (``psana_consumer.py:28-47``), but batched, prefetched, and jit-ready.
+    """
+
+    def __init__(
+        self,
+        queue,
+        batch_size: int,
+        sharding=None,
+        prefetch_depth: int = 2,
+        poll_interval_s: float = 0.01,
+        max_wait_s: Optional[float] = None,
+    ):
+        self.queue = queue
+        self.batch_size = batch_size
+        self._batches = batches_from_queue(
+            queue, batch_size, poll_interval_s=poll_interval_s, max_wait_s=max_wait_s
+        )
+        self._prefetcher = DevicePrefetcher(
+            self._batches, sharding=sharding, prefetch_depth=prefetch_depth
+        )
+
+    def __iter__(self) -> Iterator[Batch]:
+        return iter(self._prefetcher)
+
+    def close(self):
+        self._prefetcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def run(self, step: Callable[[Batch], Any], on_result: Optional[Callable] = None) -> int:
+        """Drive ``step`` over every batch until EOS; returns frames seen.
+
+        ``step`` receives device-resident Batches; results are handed to
+        ``on_result`` (if given) without forcing synchronization. The
+        prefetcher is closed on exit, normal or not."""
+        n = 0
+        try:
+            for batch in self:
+                out = step(batch)
+                n += batch.num_valid
+                if on_result is not None:
+                    on_result(out, batch)
+        finally:
+            self.close()
+        return n

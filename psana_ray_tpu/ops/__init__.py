@@ -1,0 +1,16 @@
+"""Detector calibration + analysis ops, TPU-first.
+
+The reference's only per-event compute is host-side numpy masking —
+``np.where(mask, data, 0)`` on the producer CPU (``producer.py:92-95``).
+Here the full LCLS calibration chain (pedestal subtraction, gain, per-panel
+common-mode, masking) runs jitted on the TPU over batches, with a fused
+Pallas kernel for the one-pass hot path.
+"""
+
+from psana_ray_tpu.ops.calib import (  # noqa: F401
+    apply_mask,
+    calibrate,
+    common_mode,
+    subtract_pedestal,
+)
+from psana_ray_tpu.ops.pallas_calib import fused_calibrate  # noqa: F401

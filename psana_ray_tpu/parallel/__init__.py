@@ -1,0 +1,16 @@
+"""Distribution: device meshes, sharding rules, collectives, long-context.
+
+The reference's only parallelism is data parallelism over events (SURVEY.md
+§2): MPI ranks shard the stream, competing consumers shard the queue. Here
+distribution is mesh-native: a ``jax.sharding.Mesh`` with named axes, pjit'd
+steps with NamedSharding rules, XLA collectives over ICI, plus the
+capabilities the reference lacks entirely — tensor/spatial sharding of the
+model and ring-attention sequence parallelism for long contexts.
+"""
+
+from psana_ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    create_mesh,
+    local_batch_slice,
+)
+from psana_ray_tpu.parallel.sharding import ShardingRules, infer_sharding  # noqa: F401

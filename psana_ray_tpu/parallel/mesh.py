@@ -1,0 +1,83 @@
+"""Device mesh construction + host-local batch geometry.
+
+Axes convention (scaling-book style):
+- ``data``  — batch rows (DP across hosts and chips)
+- ``model`` — tensor/spatial sharding within the model (TP)
+Optionally ``seq`` for sequence/context parallelism (ring attention).
+
+`create_mesh` infers -1 axes from the device count, so the same config runs
+on 1 real chip, an 8-device virtual CPU mesh, or a v5e-16 pod slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from psana_ray_tpu.config import MeshConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    axis_names: Tuple[str, ...]
+    axis_shape: Tuple[int, ...]
+
+    @staticmethod
+    def from_config(cfg: MeshConfig) -> "MeshSpec":
+        return MeshSpec(tuple(cfg.axis_names), tuple(cfg.axis_shape))
+
+
+def _resolve_shape(shape: Sequence[int], n_devices: int) -> Tuple[int, ...]:
+    shape = list(shape)
+    unknown = [i for i, s in enumerate(shape) if s == -1]
+    known = int(np.prod([s for s in shape if s != -1])) if shape else 1
+    if len(unknown) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if unknown:
+        if n_devices % known != 0:
+            raise ValueError(f"{n_devices} devices not divisible by fixed axes {shape}")
+        shape[unknown[0]] = n_devices // known
+    if int(np.prod(shape)) != n_devices:
+        raise ValueError(f"mesh shape {shape} != device count {n_devices}")
+    return tuple(shape)
+
+
+def create_mesh(
+    axis_names: Sequence[str] = ("data", "model"),
+    axis_shape: Sequence[int] = (-1, 1),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices, inferring any -1 axis.
+
+    Device order follows ``jax.devices()`` — on real pods that order is
+    ICI-contiguous, so neighboring mesh coordinates are ICI neighbors and
+    collectives ride ICI, not DCN."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = _resolve_shape(list(axis_shape), len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_axis_size(mesh: Mesh, data_axis: str = "data") -> int:
+    return mesh.shape[data_axis]
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh, data_axis: str = "data") -> int:
+    """Rows this *process* contributes to a global batch (multi-host DP).
+
+    Validates both constraints a ``P(data_axis)`` sharding imposes: rows
+    must split evenly over the mesh's data axis AND over the hosts."""
+    d = data_axis_size(mesh, data_axis)
+    if global_batch % d != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data axis size {d}"
+        )
+    if global_batch % jax.process_count() != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {jax.process_count()} hosts"
+        )
+    return global_batch // jax.process_count()

@@ -1,0 +1,67 @@
+"""Named sharding rules: map logical array axes -> mesh axes.
+
+A tiny, explicit version of the "logical axis rules" idiom: each parameter
+or activation names its axes (e.g. ``("batch", "panel", "height", "width")``)
+and the rules table maps logical names to mesh axis names (or None =
+replicate). This keeps model code free of mesh knowledge — the same flax
+module pjit's under any rules table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Logical-name -> mesh-axis mapping."""
+
+    rules: Tuple[Tuple[str, Optional[str]], ...] = (
+        ("batch", "data"),
+        ("embed", None),
+        ("heads", "model"),
+        ("kv", None),
+        ("mlp", "model"),
+        ("channels_in", None),
+        ("channels_out", "model"),
+        ("panel", None),
+        ("height", None),
+        ("width", None),
+        ("seq", "seq"),
+    )
+
+    def mesh_axis(self, logical: Optional[str]) -> Optional[str]:
+        if logical is None:
+            return None
+        for name, axis in self.rules:
+            if name == logical:
+                return axis
+        return None
+
+    def spec(self, logical_axes: Sequence[Optional[str]], mesh: Mesh) -> P:
+        """PartitionSpec for an array with the given logical axis names.
+        Mesh axes absent from the mesh degrade to replication, so rules
+        mentioning 'seq' still work on a ('data','model') mesh."""
+        return P(
+            *(
+                axis if (axis := self.mesh_axis(l)) in mesh.axis_names else None
+                for l in logical_axes
+            )
+        )
+
+    def sharding(self, logical_axes: Sequence[Optional[str]], mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes, mesh))
+
+
+def infer_sharding(pytree_logical, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    rules = rules or ShardingRules()
+    return jax.tree.map(
+        lambda axes: rules.sharding(axes, mesh),
+        pytree_logical,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+    )

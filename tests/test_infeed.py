@@ -1,0 +1,197 @@
+"""Infeed: fixed-shape batching, pad+mask tails, device prefetch, end-to-end
+queue->mesh flow on the 8-device CPU mesh."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psana_ray_tpu.infeed import DevicePrefetcher, FrameBatcher, InfeedPipeline
+from psana_ray_tpu.infeed.batcher import batches_from_queue
+from psana_ray_tpu.infeed.multihost import batch_sharding, make_global_batch
+from psana_ray_tpu.parallel import create_mesh
+from psana_ray_tpu.records import EndOfStream, FrameRecord
+from psana_ray_tpu.transport import RingBuffer
+
+
+def _rec(i, shape=(2, 8, 16), rank=0):
+    return FrameRecord(rank, i, np.full(shape, float(i), np.float32), 9.0 + i)
+
+
+class TestBatcher:
+    def test_emits_full_batches(self):
+        b = FrameBatcher(batch_size=4)
+        outs = [b.push(_rec(i)) for i in range(9)]
+        batches = [o for o in outs if o is not None]
+        assert len(batches) == 2
+        assert batches[0].frames.shape == (4, 2, 8, 16)
+        assert batches[0].valid.tolist() == [1, 1, 1, 1]
+        assert batches[1].event_idx.tolist() == [4, 5, 6, 7]
+        assert b.pending == 1
+
+    def test_flush_pads_tail(self):
+        b = FrameBatcher(batch_size=4)
+        for i in range(2):
+            b.push(_rec(i))
+        tail = b.flush()
+        assert tail.frames.shape == (4, 2, 8, 16)
+        assert tail.valid.tolist() == [1, 1, 0, 0]
+        assert tail.num_valid == 2
+        np.testing.assert_array_equal(tail.frames[2:], 0)  # padding rows zero
+        assert b.flush() is None
+
+    def test_metadata_alignment(self):
+        b = FrameBatcher(batch_size=3)
+        b.push(_rec(10, rank=5))
+        b.push(_rec(11, rank=6))
+        out = b.push(_rec(12, rank=7))
+        assert out.shard_rank.tolist() == [5, 6, 7]
+        assert out.photon_energy.tolist() == pytest.approx([19.0, 20.0, 21.0])
+
+    def test_shape_lock(self):
+        b = FrameBatcher(batch_size=2)
+        b.push(_rec(0))
+        with pytest.raises(ValueError, match="locked shape"):
+            b.push(_rec(1, shape=(2, 8, 17)))
+
+    def test_rejects_bad_batch_size(self):
+        with pytest.raises(ValueError):
+            FrameBatcher(batch_size=0)
+
+
+class TestBatchesFromQueue:
+    def test_drains_until_eos(self):
+        q = RingBuffer(maxsize=64)
+        for i in range(10):
+            q.put(_rec(i))
+        q.put(EndOfStream(total_events=10))
+        batches = list(batches_from_queue(q, batch_size=4, poll_interval_s=0.001))
+        assert [b.num_valid for b in batches] == [4, 4, 2]
+        all_idx = np.concatenate([b.event_idx[b.valid.astype(bool)] for b in batches])
+        assert all_idx.tolist() == list(range(10))
+
+    def test_max_wait_stops_starved_stream(self):
+        q = RingBuffer(maxsize=4)
+        q.put(_rec(0))
+        batches = list(
+            batches_from_queue(q, batch_size=4, poll_interval_s=0.005, max_wait_s=0.02)
+        )
+        # tail flushed on starvation timeout even without EOS
+        assert len(batches) == 1 and batches[0].num_valid == 1
+
+    def test_concurrent_producer(self):
+        q = RingBuffer(maxsize=8)
+
+        def produce():
+            for i in range(20):
+                while not q.put(_rec(i)):
+                    pass
+            q.put(EndOfStream())
+
+        t = threading.Thread(target=produce)
+        t.start()
+        batches = list(batches_from_queue(q, batch_size=8, poll_interval_s=0.001))
+        t.join()
+        assert sum(b.num_valid for b in batches) == 20
+
+
+class TestDevicePrefetch:
+    def test_batches_land_on_device(self):
+        q = RingBuffer(maxsize=32)
+        for i in range(8):
+            q.put(_rec(i))
+        q.put(EndOfStream())
+        pf = DevicePrefetcher(batches_from_queue(q, 4, poll_interval_s=0.001))
+        out = list(pf)
+        assert len(out) == 2
+        assert isinstance(out[0].frames, jax.Array)
+        np.testing.assert_array_equal(np.asarray(out[0].valid), 1)
+
+    def test_error_propagates(self):
+        def gen():
+            raise RuntimeError("source died")
+            yield  # noqa
+
+        pf = DevicePrefetcher(gen())
+        with pytest.raises(RuntimeError, match="source died"):
+            list(pf)
+
+    def test_exhausted_iterator_keeps_raising(self):
+        q = RingBuffer(maxsize=8)
+        q.put(_rec(0))
+        q.put(EndOfStream())
+        pf = DevicePrefetcher(batches_from_queue(q, 1, poll_interval_s=0.001))
+        assert len(list(pf)) == 1
+        with pytest.raises(StopIteration):  # not a deadlock
+            next(pf)
+
+    def test_close_releases_thread_on_early_exit(self):
+        q = RingBuffer(maxsize=64)
+        for i in range(32):
+            q.put(_rec(i))
+        q.put(EndOfStream())
+        pf = DevicePrefetcher(batches_from_queue(q, 4, poll_interval_s=0.001), prefetch_depth=2)
+        next(pf)  # consume one, then abandon
+        pf.close()
+        assert not pf._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def test_num_valid_is_host_int_after_transfer(self):
+        q = RingBuffer(maxsize=8)
+        for i in range(3):
+            q.put(_rec(i))
+        q.put(EndOfStream())
+        pf = DevicePrefetcher(batches_from_queue(q, 4, poll_interval_s=0.001))
+        (batch,) = list(pf)
+        assert isinstance(batch.num_valid, int) and batch.num_valid == 3
+
+    def test_sharded_prefetch_on_mesh(self):
+        mesh = create_mesh(("data", "model"), (8, 1))
+        sharding = batch_sharding(mesh)
+        q = RingBuffer(maxsize=32)
+        for i in range(8):
+            q.put(_rec(i))
+        q.put(EndOfStream())
+        pf = DevicePrefetcher(batches_from_queue(q, 8, poll_interval_s=0.001), sharding=sharding)
+        (batch,) = list(pf)
+        # rows split over the 8 data-axis devices
+        assert len(batch.frames.sharding.device_set) == 8
+        assert batch.frames.shape == (8, 2, 8, 16)
+
+
+class TestPipelineEndToEnd:
+    def test_jitted_consumer_over_mesh(self):
+        mesh = create_mesh(("data", "model"), (4, 2))
+        sharding = batch_sharding(mesh)
+        q = RingBuffer(maxsize=64)
+        for i in range(19):  # deliberately not a multiple of 8 -> padded tail
+            q.put(_rec(i))
+        q.put(EndOfStream(total_events=19))
+
+        pipe = InfeedPipeline(q, batch_size=8, sharding=sharding, poll_interval_s=0.001)
+
+        @jax.jit
+        def step(frames, valid):
+            # masked per-frame mean: padding rows contribute 0
+            per = jnp.mean(frames, axis=(1, 2, 3)) * valid
+            return jnp.sum(per)
+
+        totals = []
+        seen = pipe.run(lambda b: totals.append(step(b.frames, b.valid)))
+        assert seen == 19
+        # frames are constant = idx, so sum of per-frame means = sum(range(19))
+        assert float(jnp.sum(jnp.stack(totals))) == pytest.approx(sum(range(19)))
+
+
+class TestMultihostHelpers:
+    def test_make_global_batch_single_process(self):
+        mesh = create_mesh(("data", "model"), (8, 1))
+        local = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+        arr = make_global_batch(local, mesh)
+        assert arr.shape == (8, 4)
+        assert len(arr.sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(arr), local)

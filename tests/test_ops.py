@@ -1,0 +1,143 @@
+"""Calibration ops vs a plain-numpy oracle; Pallas kernel vs XLA path."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from psana_ray_tpu.config import RetrievalMode
+from psana_ray_tpu.ops import apply_mask, calibrate, common_mode, fused_calibrate
+from psana_ray_tpu.ops.calib import gain_correct, subtract_pedestal
+from psana_ray_tpu.sources import SyntheticSource
+
+
+@pytest.fixture(scope="module")
+def frame_set():
+    src = SyntheticSource(num_events=3, detector_name="epix100", seed=3)
+    raws = np.stack([src.event(i, RetrievalMode.RAW)[0] for i in range(3)])
+    return {
+        "raw": raws,  # [3, 1, 704, 768]
+        "pedestal": src.pedestal(),
+        "gain": src.gain_map(),
+        "mask": src.create_bad_pixel_mask(),
+        "src": src,
+    }
+
+
+def test_apply_mask_parity():
+    # reference semantics: np.where(mask, data, 0) (producer.py:92-95)
+    x = np.random.default_rng(0).normal(size=(2, 4, 8)).astype(np.float32)
+    mask = (np.random.default_rng(1).random((2, 4, 8)) > 0.3).astype(np.uint8)
+    out = np.asarray(apply_mask(jnp.asarray(x), jnp.asarray(mask)))
+    np.testing.assert_array_equal(out, np.where(mask, x, 0))
+
+
+def test_apply_mask_broadcasts_over_batch():
+    x = np.ones((5, 2, 4, 8), np.float32)
+    mask = np.zeros((2, 4, 8), np.uint8)
+    assert np.asarray(apply_mask(jnp.asarray(x), jnp.asarray(mask))).sum() == 0
+
+
+def test_pedestal_and_gain():
+    x = np.full((1, 4, 8), 110.0, np.float32)
+    ped = np.full((1, 4, 8), 100.0, np.float32)
+    gain = np.full((1, 4, 8), 2.0, np.float32)
+    out = gain_correct(subtract_pedestal(jnp.asarray(x), jnp.asarray(ped)), jnp.asarray(gain))
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+
+
+@pytest.mark.parametrize("algorithm", ["mean", "median"])
+def test_common_mode_removes_offset(algorithm):
+    # background-only panels with a known per-panel offset -> exact removal
+    rng = np.random.default_rng(0)
+    base = rng.normal(0, 1, size=(2, 16, 128)).astype(np.float32)
+    offsets = np.array([5.0, -3.0], np.float32)[:, None, None]
+    corrected = np.asarray(common_mode(jnp.asarray(base + offsets), threshold=100.0,
+                                       algorithm=algorithm))
+    # after correction panel centers are ~0, not ~±offset
+    est = np.median(corrected, axis=(-2, -1)) if algorithm == "median" else corrected.mean((-2, -1))
+    np.testing.assert_allclose(est, 0.0, atol=0.15)
+
+
+def test_common_mode_ignores_signal_pixels():
+    # bright peaks above threshold must not drag the baseline
+    x = np.zeros((1, 16, 128), np.float32) + 2.0
+    x[0, 8, :64] = 1000.0  # signal
+    out = np.asarray(common_mode(jnp.asarray(x), threshold=10.0, algorithm="mean"))
+    np.testing.assert_allclose(out[0, 0, 0], 0.0, atol=1e-5)  # 2.0 baseline removed
+
+
+def test_common_mode_respects_mask():
+    x = np.zeros((1, 16, 128), np.float32)
+    x[0, :8] = 4.0  # top half is "hot" but masked off
+    mask = np.ones((1, 16, 128), np.uint8)
+    mask[0, :8] = 0
+    out = np.asarray(common_mode(jnp.asarray(x), mask=jnp.asarray(mask), threshold=100.0))
+    np.testing.assert_allclose(out[0, 8:], 0.0, atol=1e-6)
+
+
+def test_calibrate_recovers_photons(frame_set):
+    # raw = ped + adu_gain * photons * gain + cm + noise; calibrate should
+    # recover ~adu_gain*photons (we don't divide by adu_gain — that's the
+    # detector gain map, not the photon conversion)
+    fs = frame_set
+    out = np.asarray(
+        calibrate(
+            jnp.asarray(fs["raw"]),
+            jnp.asarray(fs["pedestal"]),
+            jnp.asarray(fs["gain"]),
+            jnp.asarray(fs["mask"]),
+            cm_threshold=20.0,
+        )
+    )
+    calib_truth = np.stack(
+        [fs["src"].event(i, RetrievalMode.CALIB)[0] for i in range(3)]
+    ) * fs["src"].spec.adu_gain
+    good = fs["mask"].astype(bool)
+    # background pixels should sit near 0; peak pixels near the truth
+    err = np.abs(out - calib_truth)[..., good]
+    assert np.median(err) < 2.0  # noise floor ~2.5 ADU rms
+    # masked pixels exactly zero
+    assert np.all(out[..., ~good] == 0)
+
+
+def test_fused_matches_xla_path(frame_set):
+    fs = frame_set
+    args = (
+        jnp.asarray(fs["raw"]),
+        jnp.asarray(fs["pedestal"]),
+        jnp.asarray(fs["gain"]),
+        jnp.asarray(fs["mask"]),
+    )
+    ref = np.asarray(calibrate(*args, cm_threshold=10.0, cm_algorithm="mean"))
+    fused = np.asarray(fused_calibrate(*args, threshold=10.0))
+    np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_fused_integer_raw_promotes(frame_set):
+    # uint16 ADUs (typical detector readout) must promote to float, not
+    # demote the calibration constants to integers
+    fs = frame_set
+    raw_u16 = np.clip(fs["raw"], 0, 65535).astype(np.uint16)
+    args = (
+        jnp.asarray(fs["pedestal"]),
+        jnp.asarray(fs["gain"]),
+        jnp.asarray(fs["mask"]),
+    )
+    fused = np.asarray(fused_calibrate(jnp.asarray(raw_u16), *args, threshold=10.0))
+    ref = np.asarray(
+        calibrate(jnp.asarray(raw_u16.astype(np.float32)), *args, cm_threshold=10.0)
+    )
+    assert fused.dtype == np.float32
+    np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_fused_unbatched(frame_set):
+    fs = frame_set
+    out = fused_calibrate(
+        jnp.asarray(fs["raw"][0]),
+        jnp.asarray(fs["pedestal"]),
+        jnp.asarray(fs["gain"]),
+        jnp.asarray(fs["mask"]),
+    )
+    assert out.shape == fs["raw"][0].shape

@@ -1,0 +1,45 @@
+"""Canonical consumer: the reference example (``examples/psana_consumer.py``)
+re-done with typed EOS, blocking reads, and a jitted TPU step.
+
+Run (after a producer is up in the same process/deployment):
+    python examples/stream_consumer.py <consumer_id>
+
+Differences from the reference example, on purpose:
+- ``for rec in reader`` terminates on the typed EOS — the reference's loop
+  could not distinguish EOS from starvation and spun forever
+  (``psana_consumer.py:38-40``);
+- blocking reads instead of 1 s poll-sleep;
+- dead transport surfaces as DataReaderError -> clean exit (parity with
+  ``psana_consumer.py:41-44``).
+"""
+
+import sys
+import signal
+
+from psana_ray_tpu.consumer import DataReader, DataReaderError
+
+
+def consume(consumer_id: int):
+    stop = False
+
+    def _sigint(sig, frame):  # parity: psana_consumer.py:24-26
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGINT, _sigint)
+    try:
+        with DataReader() as reader:
+            for rec in reader:
+                if stop:
+                    break
+                print(
+                    f"consumer {consumer_id}: rank={rec.shard_rank} idx={rec.event_idx} "
+                    f"shape={rec.panels.shape} energy={rec.photon_energy:.2f}"
+                )
+        print(f"consumer {consumer_id}: end of stream")
+    except DataReaderError as e:
+        print(f"consumer {consumer_id}: queue is dead ({e}); exiting")
+
+
+if __name__ == "__main__":
+    consume(int(sys.argv[1]) if len(sys.argv) > 1 else 0)

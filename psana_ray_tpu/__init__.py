@@ -26,3 +26,17 @@ __version__ = "0.1.0"
 
 from psana_ray_tpu.records import EndOfStream, FrameRecord  # noqa: F401
 from psana_ray_tpu.config import PipelineConfig  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: keep `import psana_ray_tpu` fast and JAX-free for pure
+    # transport/producer processes
+    if name == "DataReader":
+        from psana_ray_tpu.consumer import DataReader
+
+        return DataReader
+    if name == "ProducerRuntime":
+        from psana_ray_tpu.producer import ProducerRuntime
+
+        return ProducerRuntime
+    raise AttributeError(name)

@@ -1,0 +1,87 @@
+"""Checkpoint / resume: model state via orbax + stream cursors.
+
+The reference has none (SURVEY.md §5: "a crashed consumer loses in-flight
+items; a restarted producer restarts the run from the beginning"). Two
+pieces close that gap:
+
+- :class:`StreamCursor` — per-shard high-water marks of processed
+  ``event_idx`` (the provenance stamp the reference carries but never uses,
+  ``producer.py:101``). Sources accept ``start_event`` to resume past it.
+- :func:`save_train_state` / :func:`restore_train_state` — orbax-backed
+  model/optimizer state, sharding-aware (restores directly onto the mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class StreamCursor:
+    """Highest contiguous event_idx processed, per shard rank."""
+
+    positions: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    def advance(self, shard_rank: int, event_idx: int):
+        cur = self.positions.get(int(shard_rank), -1)
+        self.positions[int(shard_rank)] = max(cur, int(event_idx))
+
+    def resume_point(self, shard_rank: int) -> int:
+        """First event this shard should (re)process."""
+        return self.positions.get(int(shard_rank), -1) + 1
+
+    # -- persistence (atomic JSON; tiny, human-readable) ------------------
+    def save(self, path: str):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".cursor")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump({str(k): v for k, v in self.positions.items()}, f)
+            os.replace(tmp, path)  # atomic — a crash never corrupts the cursor
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    @staticmethod
+    def load(path: str) -> "StreamCursor":
+        if not os.path.exists(path):
+            return StreamCursor()
+        with open(path) as f:
+            raw = json.load(f)
+        return StreamCursor({int(k): int(v) for k, v in raw.items()})
+
+
+def save_train_state(path: str, state) -> None:
+    """Save a parallel.steps.TrainState (or any pytree) with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+        # orbax saves are async; block until the checkpoint is committed so
+        # "saved" means durable (a crash right after return must be safe)
+        ckptr.wait_until_finished()
+
+
+def restore_train_state(path: str, template):
+    """Restore onto the template's shardings (mesh-aware): pass a state
+    built by ``create_train_state`` on the target mesh as ``template``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        if hasattr(x, "shape")
+        else x,
+        template,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract)

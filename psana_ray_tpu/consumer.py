@@ -1,0 +1,134 @@
+"""Consumer client: the reference ``DataReader`` surface, TPU-era semantics.
+
+Parity with reference ``data_reader.py:4-48``:
+- ``DataReader(address, queue_name, namespace)`` context manager;
+- ``connect()`` — idempotent, resolves the named queue (with the
+  producer-side retry semantics the reference gave only to producers);
+- ``read()`` — one item, or None when momentarily empty (kept for drop-in
+  familiarity) — but EOS is a typed :class:`EndOfStream`, never None;
+- ``read_wait(timeout)`` — blocking read, replacing the example consumer's
+  1 s poll-sleep (``psana_consumer.py:38-40``);
+- dead transport raises :class:`DataReaderError` (parity:
+  ``data_reader.py:36-37``);
+- ``close()`` — release the connection.
+
+``address='auto'`` resolves through the in-process :class:`Registry`;
+``address='shm://...'`` / ``'tcp://host:port'`` select the cross-process /
+cross-host transports.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from psana_ray_tpu.config import TransportConfig
+from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
+from psana_ray_tpu.transport import EMPTY, Registry, RendezvousTimeout, TransportClosed
+
+
+class DataReaderError(RuntimeError):
+    """The transport died (parity: reference ``data_reader.py:46-48``)."""
+
+
+class DataReader:
+    def __init__(
+        self,
+        address: str = "auto",
+        queue_name: Optional[str] = None,
+        namespace: Optional[str] = None,
+        config: Optional[TransportConfig] = None,
+    ):
+        self.config = config or TransportConfig()
+        self.address = address if address != "auto" else self.config.address
+        self.queue_name = queue_name or self.config.queue_name
+        self.namespace = namespace or self.config.namespace
+        self._queue = None
+
+    # -- lifecycle (parity: data_reader.py:11-29,39-44) -------------------
+    def connect(self) -> "DataReader":
+        if self._queue is not None:
+            return self
+        try:
+            if self.address in ("auto", "local"):
+                self._queue = Registry.default().resolve(
+                    self.namespace,
+                    self.queue_name,
+                    retries=self.config.rendezvous_retries,
+                    interval_s=self.config.rendezvous_interval_s,
+                )
+            elif self.address.startswith("tcp://"):
+                from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+                host, _, port = self.address[len("tcp://"):].partition(":")
+                self._queue = TcpQueueClient(host, int(port))
+            elif self.address.startswith("shm://"):
+                from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
+
+                self._queue = ShmRingBuffer.attach(self.address[len("shm://"):])
+            else:
+                raise ValueError(f"unknown address scheme {self.address!r}")
+        except RendezvousTimeout as e:
+            raise DataReaderError(f"could not find queue {self.queue_name!r}: {e}") from e
+        return self
+
+    def close(self):
+        q = self._queue
+        self._queue = None
+        if q is not None and hasattr(q, "disconnect"):
+            q.disconnect()
+
+    def __enter__(self) -> "DataReader":
+        return self.connect()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- reads ------------------------------------------------------------
+    def read(self) -> Any:
+        """Non-blocking read: FrameRecord | EndOfStream | None (empty).
+        Parity: data_reader.py:31-37, with typed EOS instead of None."""
+        self._check_connected()
+        try:
+            item = self._queue.get()
+        except TransportClosed as e:
+            raise DataReaderError(str(e)) from e
+        return None if item is EMPTY else item
+
+    def read_wait(self, timeout: Optional[float] = None) -> Any:
+        """Blocking read (no 1 s poll-sleep). None only on timeout."""
+        self._check_connected()
+        try:
+            item = self._queue.get_wait(timeout=timeout)
+        except TransportClosed as e:
+            raise DataReaderError(str(e)) from e
+        return None if item is EMPTY else item
+
+    def read_batch(self, max_items: int, timeout: Optional[float] = None) -> list:
+        self._check_connected()
+        try:
+            return self._queue.get_batch(max_items, timeout=timeout)
+        except TransportClosed as e:
+            raise DataReaderError(str(e)) from e
+
+    def __iter__(self):
+        """Iterate FrameRecords until EOS (the loop the reference's example
+        couldn't write correctly — psana_consumer.py:38-40 spins forever)."""
+        self._check_connected()
+        while True:
+            item = self.read_wait(timeout=1.0)
+            if item is None:
+                continue
+            if is_eos(item):
+                return
+            yield item
+
+    def size(self) -> int:
+        self._check_connected()
+        try:
+            return self._queue.size()
+        except TransportClosed as e:
+            raise DataReaderError(str(e)) from e
+
+    def _check_connected(self):
+        if self._queue is None:
+            raise DataReaderError("not connected — call connect() or use as context manager")

@@ -1,0 +1,19 @@
+"""Flax models for the streaming-inference consumers.
+
+The reference has no model code at all — its consumers are opaque per-GPU
+torch loops ("Stream psana data from MPI sources to PyTorch",
+``project.toml:4``; SURVEY.md §2). These are the workloads `BASELINE.json`
+names as the target capability set, built TPU-first:
+
+- :class:`PeakNetUNet` — U-Net for per-pixel Bragg-peak segmentation
+  (BASELINE config 3; the PeakNet/SFX context surfaces at reference
+  ``setup.py:11,15``);
+- :class:`ResNet50` / :class:`ResNetClassifier` — diffraction hit/miss
+  classifier (BASELINE config 4);
+- all NHWC, bfloat16 compute / float32 params, GroupNorm (batch-size
+  independent — correct for streaming and padded tail batches).
+"""
+
+from psana_ray_tpu.models.resnet import ResNet18, ResNet50, ResNetClassifier  # noqa: F401
+from psana_ray_tpu.models.unet import PeakNetUNet  # noqa: F401
+from psana_ray_tpu.models.heads import panels_to_nhwc  # noqa: F401

@@ -1,0 +1,34 @@
+"""Frame-layout adapters between the detector world and the model world.
+
+Detector frames arrive as ``[B, P, H, W]`` panel stacks (records.py). TPU
+convs want NHWC with a channel axis that tiles the MXU. Two conventions:
+
+- **panel-as-channel** (classifier): ``[B, H, W, P]`` — one conv sees all
+  panels; good when the decision is global (hit/miss).
+- **panel-as-batch** (segmentation): ``[B*P, H, W, 1]`` — per-panel masks;
+  peaks live on single panels, and folding P into batch keeps every
+  conv's spatial dims identical across detectors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def panels_to_nhwc(frames: jax.Array, mode: str = "channels") -> jax.Array:
+    """``[B,P,H,W] -> [B,H,W,P]`` ("channels") or ``[B*P,H,W,1]`` ("batch")."""
+    b, p, h, w = frames.shape
+    if mode == "channels":
+        return jnp.transpose(frames, (0, 2, 3, 1))
+    if mode == "batch":
+        return jnp.reshape(frames, (b * p, h, w, 1))
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+def nhwc_to_panels(x: jax.Array, num_panels: int) -> jax.Array:
+    """Inverse of panel-as-batch: ``[B*P,H,W,C] -> [B,P,H,W]`` (C must be 1)."""
+    bp, h, w, c = x.shape
+    if c != 1:
+        raise ValueError(f"expected single channel, got {c}")
+    return jnp.reshape(x, (bp // num_panels, num_panels, h, w))

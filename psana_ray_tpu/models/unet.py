@@ -1,0 +1,76 @@
+"""PeakNet-style U-Net for Bragg-peak segmentation, flax.linen, TPU-first.
+
+BASELINE config 3: "PeakNet (U-Net) Bragg-peak segmentation on epix10k2M
+frames" — the serial-crystallography workload the reference's stale
+packaging metadata reveals ("Save PeakNet inference results to CXI",
+reference ``setup.py:11``; keyword SFX at ``setup.py:15``).
+
+Encoder/decoder with skip connections; downsampling by strided conv,
+upsampling by resize+conv (avoids transposed-conv checkerboarding);
+GroupNorm + SiLU; bfloat16 compute / float32 params; per-pixel logit
+output. Input is panel-as-batch NHWC (``heads.panels_to_nhwc(..,"batch")``)
+so one compiled program serves any panel count.
+
+Spatial constraint: H and W must be divisible by 2**depth (epix10k2M
+352x384 with depth<=5: 352 = 32*11, 384 = 32*12 -> depth 5 OK).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from psana_ray_tpu.models.resnet import _conv, _norm
+
+Dtype = Any
+
+
+class ConvBlock(nn.Module):
+    features: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = _conv(self.features, (3, 3), (1, 1), self.dtype)(x)
+        x = nn.silu(_norm(self.dtype, self.features)(x))
+        x = _conv(self.features, (3, 3), (1, 1), self.dtype)(x)
+        return nn.silu(_norm(self.dtype, self.features)(x))
+
+
+class PeakNetUNet(nn.Module):
+    """U-Net: ``[N, H, W, C_in] -> [N, H, W, num_classes]`` logits."""
+
+    features: Sequence[int] = (32, 64, 128, 256)
+    num_classes: int = 1  # peak / not-peak
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        skips = []
+        # encoder
+        for i, f in enumerate(self.features[:-1]):
+            x = ConvBlock(f, dtype=self.dtype)(x)
+            skips.append(x)
+            x = _conv(f, (3, 3), (2, 2), self.dtype)(x)  # strided downsample
+        # bottleneck
+        x = ConvBlock(self.features[-1], dtype=self.dtype)(x)
+        # decoder
+        for f, skip in zip(reversed(self.features[:-1]), reversed(skips)):
+            n, h, w, c = skip.shape
+            x = jax.image.resize(x, (x.shape[0], h, w, x.shape[-1]), "nearest")
+            x = _conv(f, (3, 3), (1, 1), self.dtype)(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock(f, dtype=self.dtype)(x)
+        # per-pixel logits in f32
+        return nn.Conv(
+            self.num_classes,
+            (1, 1),
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            name="logits",
+        )(x)

@@ -1,0 +1,260 @@
+// Lock-free bounded MPMC ring over POSIX shared memory.
+//
+// The cross-process realization of the transport contract
+// (psana_ray_tpu/transport/ring.py): put -> bool (false when full, never
+// drops), get -> length | -1 (empty), size, close-with-fault-propagation.
+// Multiple producer processes (ingest shards) and consumer processes
+// (infeed feeders) on one host share the ring with no broker process in
+// between — the role the reference delegated to a Ray actor + object store
+// (two network hops per frame, SURVEY.md §3.3); here a put is a memcpy
+// into mapped memory.
+//
+// Algorithm: Vyukov bounded MPMC queue. Each slot carries an atomic
+// sequence number; producers CAS the head, consumers CAS the tail; the
+// sequence tells whose turn a slot is. All atomics are std::atomic<u64>
+// in the mapping — lock-free on x86_64/aarch64, valid across processes
+// (the mapping is MAP_SHARED).
+//
+// Layout:  [Header][Slot 0][Slot 1]...[Slot N-1],
+//          slot = [atomic seq][u32 len][payload bytes]
+//
+// Build: make -C psana_ray_tpu/native   (g++ -O2 -shared -fPIC)
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x50525452494E4731ULL;  // "PRTRING1"
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;    // number of slots (power of two)
+  uint64_t slot_bytes;  // payload capacity per slot
+  std::atomic<uint64_t> head;  // next enqueue position
+  std::atomic<uint64_t> tail;  // next dequeue position
+  std::atomic<uint64_t> closed;
+  std::atomic<uint64_t> n_put;
+  std::atomic<uint64_t> n_get;
+  std::atomic<uint64_t> n_put_rejected;
+};
+
+struct Slot {
+  std::atomic<uint64_t> seq;
+  uint32_t len;
+  // payload follows
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* base;
+  size_t map_bytes;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+inline size_t slot_stride(uint64_t slot_bytes) {
+  // keep slots cache-line aligned
+  size_t raw = sizeof(Slot) + slot_bytes;
+  return (raw + 63) & ~size_t(63);
+}
+
+inline Slot* slot_at(Ring* r, uint64_t i) {
+  size_t stride = slot_stride(r->hdr->slot_bytes);
+  return reinterpret_cast<Slot*>(r->base + sizeof(Header) +
+                                 (i & (r->hdr->capacity - 1)) * stride);
+}
+
+uint64_t round_pow2(uint64_t v) {
+  uint64_t p = 1;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or replace) a ring named `name` with >=capacity slots of
+// slot_bytes payload each. Returns handle or null.
+void* shmring_create(const char* name, uint64_t capacity, uint64_t slot_bytes) {
+  capacity = round_pow2(capacity < 2 ? 2 : capacity);
+  size_t bytes = sizeof(Header) + capacity * slot_stride(slot_bytes);
+
+  shm_unlink(name);  // replace any stale ring of this name
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)bytes) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->base = static_cast<uint8_t*>(mem);
+  r->hdr = reinterpret_cast<Header*>(mem);
+  r->map_bytes = bytes;
+  r->fd = fd;
+  r->owner = true;
+  std::strncpy(r->name, name, sizeof(r->name) - 1);
+
+  r->hdr->capacity = capacity;
+  r->hdr->slot_bytes = slot_bytes;
+  r->hdr->head.store(0);
+  r->hdr->tail.store(0);
+  r->hdr->closed.store(0);
+  r->hdr->n_put.store(0);
+  r->hdr->n_get.store(0);
+  r->hdr->n_put_rejected.store(0);
+  for (uint64_t i = 0; i < capacity; i++) slot_at(r, i)->seq.store(i);
+  // publish magic last: attachers spin until it appears
+  reinterpret_cast<std::atomic<uint64_t>*>(&r->hdr->magic)
+      ->store(kMagic, std::memory_order_release);
+  return r;
+}
+
+// Attach to an existing ring. Returns handle or null.
+void* shmring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  if (reinterpret_cast<std::atomic<uint64_t>*>(&hdr->magic)
+          ->load(std::memory_order_acquire) != kMagic) {
+    munmap(mem, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->base = static_cast<uint8_t*>(mem);
+  r->hdr = hdr;
+  r->map_bytes = st.st_size;
+  r->fd = fd;
+  r->owner = false;
+  std::strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// put: 1 = enqueued, 0 = full, -1 = message too large, -2 = closed.
+int shmring_put(void* handle, const uint8_t* data, uint64_t len) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  if (h->closed.load(std::memory_order_acquire)) return -2;
+  if (len > h->slot_bytes) return -1;
+
+  uint64_t pos = h->head.load(std::memory_order_relaxed);
+  for (;;) {
+    Slot* s = slot_at(r, pos);
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+    if (dif == 0) {
+      if (h->head.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed)) {
+        s->len = (uint32_t)len;
+        std::memcpy(reinterpret_cast<uint8_t*>(s) + sizeof(Slot), data, len);
+        s->seq.store(pos + 1, std::memory_order_release);
+        h->n_put.fetch_add(1, std::memory_order_relaxed);
+        return 1;
+      }
+      // CAS failed: pos was reloaded, retry
+    } else if (dif < 0) {
+      h->n_put_rejected.fetch_add(1, std::memory_order_relaxed);
+      return 0;  // full
+    } else {
+      pos = h->head.load(std::memory_order_relaxed);
+    }
+  }
+}
+
+// get: >=0 payload length copied into out, -1 = empty, -2 = closed,
+// -3 = out buffer too small (message left in place).
+int64_t shmring_get(void* handle, uint8_t* out, uint64_t out_cap) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  // closed-raises-immediately, matching transport/ring.py (dead transport
+  // must surface at once; EOS is an explicit record, not a drained tail)
+  if (h->closed.load(std::memory_order_acquire)) return -2;
+  uint64_t pos = h->tail.load(std::memory_order_relaxed);
+  for (;;) {
+    Slot* s = slot_at(r, pos);
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+    if (dif == 0) {
+      if (s->len > out_cap) return -3;
+      if (h->tail.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed)) {
+        uint64_t len = s->len;
+        std::memcpy(out, reinterpret_cast<uint8_t*>(s) + sizeof(Slot), len);
+        s->seq.store(pos + h->capacity, std::memory_order_release);
+        h->n_get.fetch_add(1, std::memory_order_relaxed);
+        return (int64_t)len;
+      }
+    } else if (dif < 0) {
+      if (h->closed.load(std::memory_order_acquire)) return -2;
+      return -1;  // empty
+    } else {
+      pos = h->tail.load(std::memory_order_relaxed);
+    }
+  }
+}
+
+uint64_t shmring_size(void* handle) {
+  Header* h = static_cast<Ring*>(handle)->hdr;
+  uint64_t head = h->head.load(std::memory_order_acquire);
+  uint64_t tail = h->tail.load(std::memory_order_acquire);
+  return head > tail ? head - tail : 0;
+}
+
+uint64_t shmring_capacity(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->capacity;
+}
+
+uint64_t shmring_slot_bytes(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->slot_bytes;
+}
+
+int shmring_is_closed(void* handle) {
+  return (int)static_cast<Ring*>(handle)->hdr->closed.load(std::memory_order_acquire);
+}
+
+void shmring_close(void* handle) {
+  static_cast<Ring*>(handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+void shmring_stats(void* handle, uint64_t* out4) {
+  Header* h = static_cast<Ring*>(handle)->hdr;
+  out4[0] = shmring_size(handle);
+  out4[1] = h->n_put.load(std::memory_order_relaxed);
+  out4[2] = h->n_get.load(std::memory_order_relaxed);
+  out4[3] = h->n_put_rejected.load(std::memory_order_relaxed);
+}
+
+// Detach the mapping; destroy=1 also unlinks the shm object.
+void shmring_free(void* handle, int destroy) {
+  Ring* r = static_cast<Ring*>(handle);
+  if (destroy) shm_unlink(r->name);
+  munmap(r->base, r->map_bytes);
+  close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

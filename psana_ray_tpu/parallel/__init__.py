@@ -14,3 +14,8 @@ from psana_ray_tpu.parallel.mesh import (  # noqa: F401
     local_batch_slice,
 )
 from psana_ray_tpu.parallel.sharding import ShardingRules, infer_sharding  # noqa: F401
+from psana_ray_tpu.parallel.ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -28,6 +28,7 @@ class ShardingRules:
         ("mlp", "model"),
         ("channels_in", None),
         ("channels_out", "model"),
+        ("classes", None),
         ("panel", None),
         ("height", None),
         ("width", None),

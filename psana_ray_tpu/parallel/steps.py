@@ -1,0 +1,132 @@
+"""Sharded init / inference / training steps over a mesh.
+
+The bridge between mesh-agnostic flax models (models/) and the device mesh:
+params are initialized directly into their mesh shardings (no host-side
+giant pytree), inference and train steps are jit'd with explicit
+in/out shardings, and gradient reduction across the data axis is implicit
+in the shardings — XLA inserts the psums over ICI (scaling-book recipe:
+annotate, don't hand-write collectives).
+
+The reference has no counterpart (its consumers are opaque torch loops);
+this is the "pjit'd model" half of the BASELINE north star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.core import meta as nn_meta
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from psana_ray_tpu.parallel.sharding import ShardingRules
+
+
+def _mesh_shardings_for_variables(abstract_vars, mesh: Mesh, rules: ShardingRules):
+    """Logical-axis metadata (nn.with_logical_partitioning) -> NamedShardings.
+    Unannotated leaves replicate."""
+    logical = nn.get_partition_spec(abstract_vars)
+    rules_tuple = tuple((l, a) for l, a in rules.rules)
+    return nn.logical_to_mesh_sharding(logical, mesh, rules_tuple)
+
+
+def init_sharded(
+    model: nn.Module,
+    rng: jax.Array,
+    sample: jax.Array,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+):
+    """Initialize variables directly into their mesh shardings.
+
+    Returns an *unboxed* params pytree (plain arrays, each carrying its
+    NamedSharding) — optax and checkpointing consume it directly."""
+    rules = rules or ShardingRules()
+    abstract = jax.eval_shape(model.init, rng, sample)
+    shardings = _mesh_shardings_for_variables(abstract, mesh, rules)
+    variables = jax.jit(model.init, out_shardings=shardings)(rng, sample)
+    return nn_meta.unbox(variables)
+
+
+def make_infer_step(model: nn.Module, mesh: Mesh, data_axis: str = "data"):
+    """jit'd ``(variables, x) -> logits`` with batch rows over the data axis."""
+    x_sharding = NamedSharding(mesh, P(data_axis))
+
+    @jax.jit
+    def infer(variables, x):
+        return model.apply(variables, x)
+
+    def step(variables, x):
+        return infer(variables, jax.device_put(x, x_sharding) if not isinstance(x, jax.Array) else x)
+
+    return step
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Minimal train state (params + opt state + step counter)."""
+
+    variables: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_train_step(
+    model: nn.Module,
+    optimizer: optax.GradientTransformation,
+    loss_fn: Callable[..., jax.Array],
+    donate: bool = True,
+):
+    """Build ``(state, batch) -> (state, loss)``.
+
+    ``loss_fn(logits, batch) -> scalar``. Gradient reduction over the data
+    axis happens inside jit via the sharding propagation (batch sharded on
+    'data', params replicated/TP -> XLA inserts psum on the grads).
+    ``donate=True`` donates the state buffers, so params update in place —
+    essential at ResNet-50 scale on a 16 GB chip."""
+
+    def _step(state: TrainState, x: jax.Array, batch_aux) -> Tuple[TrainState, jax.Array]:
+        def loss_of(variables):
+            logits = model.apply(variables, x)
+            return loss_fn(logits, batch_aux)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.variables)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.variables)
+        variables = optax.apply_updates(state.variables, updates)
+        return TrainState(variables, opt_state, state.step + 1), loss
+
+    return jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+
+def create_train_state(
+    model: nn.Module,
+    optimizer: optax.GradientTransformation,
+    rng: jax.Array,
+    sample: jax.Array,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+) -> TrainState:
+    variables = init_sharded(model, rng, sample, mesh, rules)
+    # Moment buffers inherit the param shardings; scalar leaves (e.g. adam's
+    # count) must be explicitly replicated across the mesh — left on a
+    # single device, the first train step after a checkpoint restore fails
+    # with "incompatible devices" (restore preserves committed shardings).
+    opt_state = jax.jit(optimizer.init)(variables)
+    replicated = NamedSharding(mesh, P())
+    opt_state = jax.tree.map(
+        lambda x: jax.device_put(x, replicated)
+        if hasattr(x, "sharding") and len(x.sharding.device_set) < mesh.size
+        else x,
+        opt_state,
+    )
+    step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+    return TrainState(variables, opt_state, step)
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["variables", "opt_state", "step"], meta_fields=[]
+)

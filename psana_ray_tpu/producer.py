@@ -1,0 +1,234 @@
+"""Producer runtime + CLI: sharded ingest into a named, backpressured queue.
+
+The reference's producer (``producer.py``) is an MPI program: N ranks, each
+reading its psana shard and pushing framed events through a blocking RPC,
+with barriers at bootstrap/shutdown and rank 0 emitting one EOS sentinel
+per consumer (``producer.py:119-130``). This runtime keeps every protocol —
+shard-per-worker ingest, get-or-create rendezvous, backpressure with the
+same backoff envelope, barrier-then-EOS, dead-queue detection, SIGINT
+handling, ``--max_steps`` — but as an explicit, testable object that runs
+shards as threads in one process (TPU hosts are fed per-process; event
+generation releases the GIL in numpy) or as one shard of a multi-host
+deployment via ``shard_rank/num_shards``.
+
+All 13 reference flags (``producer.py:17-33``) are covered by
+:class:`PipelineConfig`; the CLI exposes them with the same names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, SourceConfig, TransportConfig
+from psana_ray_tpu.records import EndOfStream, FrameRecord
+from psana_ray_tpu.sources import open_source
+from psana_ray_tpu.transport import BackoffPolicy, Registry, RingBuffer, TransportClosed
+from psana_ray_tpu.utils.metrics import PipelineMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class ProducerRuntime:
+    """Drives ``num_shards`` ingest workers into one named queue."""
+
+    def __init__(
+        self,
+        config: PipelineConfig,
+        registry: Optional[Registry] = None,
+        num_local_shards: int = 1,
+        shard_rank_offset: int = 0,
+        total_shards: Optional[int] = None,
+    ):
+        self.config = config
+        self.registry = registry or Registry.default()
+        self.num_local_shards = num_local_shards
+        self.shard_rank_offset = shard_rank_offset
+        self.total_shards = total_shards or num_local_shards
+        self.metrics = PipelineMetrics()
+        self._queue = None
+        self._barrier = threading.Barrier(num_local_shards)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._errors: List[BaseException] = []
+
+    # -- rendezvous (parity: producer.py:35-71) ---------------------------
+    def bootstrap(self):
+        t = self.config.transport
+        self._queue = self.registry.get_or_create(
+            t.namespace, t.queue_name, lambda: RingBuffer(t.queue_size, name=t.queue_name)
+        )
+        logger.info("queue %r ready (namespace=%r size=%d)", t.queue_name, t.namespace, t.queue_size)
+        return self._queue
+
+    # -- per-shard event pump (parity: produce_data, producer.py:78-130) --
+    def _pump(self, local_idx: int):
+        cfg = self.config
+        rank = self.shard_rank_offset + local_idx
+        t = cfg.transport
+        try:
+            source = open_source(
+                cfg.source.exp,
+                cfg.source.run,
+                cfg.source.detector_name,
+                shard_rank=rank,
+                num_shards=self.total_shards,
+                num_events=cfg.source.num_events,
+                seed=cfg.source.seed,
+                dtype=cfg.source.dtype,
+            )
+            mask = self._load_mask(source)
+            backoff = BackoffPolicy(t.backoff_base_s, t.backoff_cap_s, t.backoff_jitter_s)
+            produced = 0
+            for idx, (data, energy) in zip(
+                source.shard_event_indices(), source.iter_events(cfg.source.mode)
+            ):
+                if self._stop.is_set():
+                    break
+                if cfg.source.max_steps is not None and produced >= cfg.source.max_steps:
+                    logger.info("rank %d: reached max_steps=%d", rank, cfg.source.max_steps)
+                    break
+                if mask is not None:
+                    data = np.where(mask, data, 0)  # parity: producer.py:92-95
+                rec = FrameRecord(rank, int(idx), data, energy, timestamp=time.time())
+                while not self._stop.is_set():
+                    try:
+                        if self._queue.put(rec):
+                            backoff.reset()
+                            self.metrics.observe_frame(rec.nbytes)
+                            produced += 1
+                            logger.debug(
+                                "rank %d produced idx=%d shape=%s energy=%.2f",
+                                rank, idx, rec.panels.shape, energy,
+                            )
+                            break
+                        logger.debug("rank %d queue full; backoff", rank)
+                        backoff.wait()  # parity: producer.py:106-111
+                    except TransportClosed:
+                        logger.warning("rank %d: queue dead, exiting", rank)
+                        return  # parity: producer.py:112-114
+            # barrier so EOS follows ALL shards' data (parity: producer.py:120)
+            self._barrier.wait(timeout=600)
+            if local_idx == 0:
+                self._emit_eos()
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised in run()
+            self._errors.append(e)
+            logger.exception("rank %d failed", rank)
+            try:
+                self._barrier.abort()
+            except Exception:
+                pass
+
+    def _emit_eos(self):
+        """Rank 0 puts one typed EOS per expected consumer
+        (parity: producer.py:121-126, tolerating a dead queue :127-130)."""
+        t = self.config.transport
+        for _ in range(t.num_consumers):
+            try:
+                while not self._queue.put_wait(
+                    EndOfStream(producer_rank=self.shard_rank_offset), timeout=5.0
+                ):
+                    if self._stop.is_set():
+                        return
+            except TransportClosed:
+                logger.warning("queue died before EOS could be delivered")
+                return
+        logger.info("EOS delivered to %d consumer(s)", t.num_consumers)
+
+    def _load_mask(self, source) -> Optional[np.ndarray]:
+        m = self.config.mask
+        mask = None
+        if m.uses_bad_pixel_mask:
+            mask = source.create_bad_pixel_mask()  # parity: producer.py:81
+        if m.manual_mask_path:
+            manual = np.load(m.manual_mask_path)  # parity: producer.py:82
+            mask = manual if mask is None else (mask.astype(bool) & manual.astype(bool))
+        return mask
+
+    # -- lifecycle --------------------------------------------------------
+    def run(self, block: bool = True):
+        if self._queue is None:
+            self.bootstrap()
+        self._threads = [
+            threading.Thread(target=self._pump, args=(i,), name=f"producer-shard-{i}")
+            for i in range(self.num_local_shards)
+        ]
+        for t in self._threads:
+            t.start()
+        if block:
+            self.join()
+
+    def join(self):
+        for t in self._threads:
+            t.join()
+        if self._errors:
+            raise self._errors[0]
+
+    def stop(self):
+        self._stop.set()
+
+
+def parse_arguments(argv=None):
+    """All 13 reference flags (``producer.py:17-33``), same spellings."""
+    p = argparse.ArgumentParser(prog="psana-ray-tpu-producer")
+    p.add_argument("--exp", default="synthetic")
+    p.add_argument("--run", type=int, default=1)
+    p.add_argument("--detector_name", default="epix10k2M")
+    p.add_argument("--calib", action="store_true", help="calibrated mode (else raw)")
+    p.add_argument("--uses_bad_pixel_mask", action="store_true")
+    p.add_argument("--manual_mask_path", default=None)
+    p.add_argument("--ray_address", "--address", dest="address", default="auto")
+    p.add_argument("--ray_namespace", "--namespace", dest="namespace", default="default")
+    p.add_argument("--queue_name", default="shared_queue")
+    p.add_argument("--queue_size", type=int, default=100)
+    p.add_argument("--num_consumers", type=int, default=1)
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
+    p.add_argument("--num_events", type=int, default=1024, help="synthetic events")
+    a = p.parse_args(argv)
+    return PipelineConfig(
+        source=SourceConfig(
+            exp=a.exp,
+            run=a.run,
+            detector_name=a.detector_name,
+            mode=RetrievalMode.CALIB if a.calib else RetrievalMode.RAW,
+            max_steps=a.max_steps,
+            num_events=a.num_events,
+        ),
+        mask=MaskConfig(a.uses_bad_pixel_mask, a.manual_mask_path),
+        transport=TransportConfig(
+            address=a.address,
+            namespace=a.namespace,
+            queue_name=a.queue_name,
+            queue_size=a.queue_size,
+            num_consumers=a.num_consumers,
+        ),
+    ), a
+
+
+def main(argv=None):
+    config, args = parse_arguments(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format=config.log.fmt,  # parity: producer.py:135-136
+    )
+    runtime = ProducerRuntime(config, num_local_shards=args.num_shards)
+
+    def _sigint(signum, frame):  # parity: producer.py:73-76,142-143
+        logger.info("SIGINT — stopping producer")
+        runtime.stop()
+
+    signal.signal(signal.SIGINT, _sigint)
+    runtime.run(block=True)
+    logger.info("producer done: %s", runtime.metrics.status_line())
+
+
+if __name__ == "__main__":
+    main()

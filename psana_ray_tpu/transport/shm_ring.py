@@ -1,0 +1,253 @@
+"""Cross-process shared-memory ring: ctypes bindings over the C++ MPMC ring.
+
+Same contract as :class:`psana_ray_tpu.transport.ring.RingBuffer` — put ->
+bool / get -> item|EMPTY / size / close-with-TransportClosed — but the
+queue lives in POSIX shared memory, so independent producer and consumer
+*processes* on one host exchange frames with a single memcpy each way (the
+reference needed two cross-node object-store hops through a Ray actor,
+SURVEY.md §3.3).
+
+Payloads are the wire format of :mod:`psana_ray_tpu.records` (FrameRecord /
+EndOfStream); arbitrary Python objects are supported via pickle with a
+1-byte tag.
+
+The C library builds on demand with ``make`` (g++); see
+``psana_ray_tpu/native/``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord, decode
+from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.ring import EMPTY
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libshmring.so")
+_TAG_RECORD = b"R"  # records wire format
+_TAG_PICKLE = b"P"
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib() -> ctypes.CDLL:
+    """Load (building if needed) the native library. Raises RuntimeError
+    with guidance when no toolchain is available."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-s"],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired) as e:
+                detail = getattr(e, "stderr", b"")
+                raise RuntimeError(
+                    "could not build native shm ring (needs g++/make); use the "
+                    f"in-process RingBuffer or TCP transport instead: {detail!r}"
+                ) from e
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.shmring_create.restype = ctypes.c_void_p
+        lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.shmring_attach.restype = ctypes.c_void_p
+        lib.shmring_attach.argtypes = [ctypes.c_char_p]
+        lib.shmring_put.restype = ctypes.c_int
+        lib.shmring_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.shmring_get.restype = ctypes.c_int64
+        lib.shmring_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        for fn in ("shmring_size", "shmring_capacity", "shmring_slot_bytes"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.shmring_is_closed.restype = ctypes.c_int
+        lib.shmring_is_closed.argtypes = [ctypes.c_void_p]
+        lib.shmring_close.argtypes = [ctypes.c_void_p]
+        lib.shmring_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
+        lib.shmring_free.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        _load_lib()
+        return True
+    except RuntimeError:
+        return False
+
+
+class ShmRingBuffer:
+    """MPMC shared-memory queue; create on one process, attach on others."""
+
+    # epix10k2M f32 frame = 8.6 MB; default slot fits it + header slack
+    DEFAULT_SLOT_BYTES = 9 * 1024 * 1024
+
+    def __init__(self, handle, name: str, owner: bool):
+        self._h = handle
+        self.name = name
+        self._owner = owner
+        self._lib = _load_lib()
+        self._recv = ctypes.create_string_buffer(int(self._lib.shmring_slot_bytes(handle)))
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def create(
+        cls, name: str, maxsize: int = 64, slot_bytes: int = DEFAULT_SLOT_BYTES
+    ) -> "ShmRingBuffer":
+        lib = _load_lib()
+        h = lib.shmring_create(cls._shm_name(name), maxsize, slot_bytes)
+        if not h:
+            raise RuntimeError(f"shmring_create({name!r}) failed")
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def attach(cls, name: str, retries: int = 10, interval_s: float = 1.0) -> "ShmRingBuffer":
+        """Attach with the rendezvous retry semantics (producer.py:56-67)."""
+        lib = _load_lib()
+        deadline = time.monotonic() + retries * interval_s
+        while True:
+            h = lib.shmring_attach(cls._shm_name(name))
+            if h:
+                return cls(h, name, owner=False)
+            if time.monotonic() >= deadline:
+                from psana_ray_tpu.transport.registry import RendezvousTimeout
+
+                raise RendezvousTimeout(
+                    f"shm ring {name!r} not found after {retries} x {interval_s}s"
+                )
+            time.sleep(interval_s)
+
+    @staticmethod
+    def _shm_name(name: str) -> bytes:
+        clean = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        return f"/psana_ray_tpu_{clean}".encode()
+
+    # -- transport contract ----------------------------------------------
+    def put(self, item: Any) -> bool:
+        payload = self._encode(item)
+        rc = self._lib.shmring_put(self._h, payload, len(payload))
+        if rc == 1:
+            return True
+        if rc == 0:
+            return False
+        if rc == -2:
+            raise TransportClosed(f"shm ring {self.name!r} is closed")
+        raise ValueError(
+            f"message of {len(payload)} bytes exceeds slot size "
+            f"{int(self._lib.shmring_slot_bytes(self._h))}"
+        )
+
+    def get(self) -> Any:
+        n = self._lib.shmring_get(self._h, self._recv, len(self._recv))
+        if n == -1:
+            return EMPTY
+        if n == -2:
+            raise TransportClosed(f"shm ring {self.name!r} is closed")
+        if n == -3:
+            raise RuntimeError("receive buffer smaller than message (corrupt ring?)")
+        return self._decode(self._recv.raw[: int(n)])
+
+    def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.0002) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            item = self.get()
+            if item is not EMPTY:
+                return item
+            if deadline is not None and time.monotonic() >= deadline:
+                return EMPTY
+            time.sleep(poll_s)
+
+    def put_wait(self, item: Any, timeout: Optional[float] = None, poll_s: float = 0.0002) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.put(item):
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def get_batch(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
+        out = []
+        first = self.get_wait(timeout=timeout)
+        if first is EMPTY:
+            return out
+        out.append(first)
+        while len(out) < max_items:
+            item = self.get()
+            if item is EMPTY:
+                break
+            out.append(item)
+        return out
+
+    def size(self) -> int:
+        return int(self._lib.shmring_size(self._h))
+
+    @property
+    def maxsize(self) -> int:
+        return int(self._lib.shmring_capacity(self._h))
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._lib.shmring_is_closed(self._h))
+
+    def close(self):
+        self._lib.shmring_close(self._h)
+
+    def stats(self) -> dict:
+        buf = (ctypes.c_uint64 * 4)()
+        self._lib.shmring_stats(self._h, ctypes.byref(buf))
+        return {
+            "depth": int(buf[0]),
+            "maxsize": self.maxsize,
+            "puts": int(buf[1]),
+            "gets": int(buf[2]),
+            "puts_rejected": int(buf[3]),
+        }
+
+    def disconnect(self):
+        """Detach this handle (the ring survives for other processes)."""
+        if self._h:
+            self._lib.shmring_free(self._h, 0)
+            self._h = None
+
+    def destroy(self):
+        """Detach AND unlink the shared memory object."""
+        if self._h:
+            self._lib.shmring_free(self._h, 1)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.disconnect()
+        except Exception:
+            pass
+
+    # -- payload codec ----------------------------------------------------
+    @staticmethod
+    def _encode(item: Any) -> bytes:
+        if isinstance(item, (FrameRecord, EndOfStream)):
+            return _TAG_RECORD + item.to_bytes()
+        return _TAG_PICKLE + pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def _decode(buf: bytes) -> Any:
+        tag, body = buf[:1], buf[1:]
+        if tag == _TAG_RECORD:
+            return decode(body)
+        if tag == _TAG_PICKLE:
+            return pickle.loads(body)
+        raise ValueError(f"unknown payload tag {tag!r}")

@@ -1,0 +1,214 @@
+"""Cross-host transport: a TCP queue server + client with the transport
+contract.
+
+The reference's cross-node data plane is Ray's object store + actor RPC
+(SURVEY.md §5 "Distributed communication backend"). Here the cross-host
+hop is an explicit length-prefixed TCP protocol over any local queue
+(RingBuffer or ShmRingBuffer): producers on ingest nodes connect and PUT,
+consumers on TPU hosts connect and GET. One server per queue — the same
+single-serialization-point design as the reference's actor, without the
+object-store copy.
+
+Wire protocol (all little-endian):
+    request:  op:u8 ('P'|'G'|'S'|'C') + [P only] len:u32 + payload
+    response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
+              + [G ok] len:u32 + payload   + [S] size:u32
+
+Payloads reuse the shm codec (records wire format / tagged pickle).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, List, Optional
+
+from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.ring import EMPTY, RingBuffer
+from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
+
+_OP_PUT = b"P"
+_OP_GET = b"G"
+_OP_SIZE = b"S"
+_OP_CLOSE = b"C"
+_ST_OK = b"1"
+_ST_NO = b"0"
+_ST_CLOSED = b"X"
+_ST_ERR = b"E"
+
+_encode = ShmRingBuffer._encode
+_decode = ShmRingBuffer._decode
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class TcpQueueServer:
+    """Serve a local queue over TCP. Start with ``serve_background()``."""
+
+    def __init__(self, queue=None, host: str = "0.0.0.0", port: int = 0, maxsize: int = 100):
+        self.queue = queue if queue is not None else RingBuffer(maxsize)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def serve_background(self) -> "TcpQueueServer":
+        t = threading.Thread(target=self._accept_loop, daemon=True, name="tcp-queue-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                op = _recv_exact(conn, 1)
+                try:
+                    if op == _OP_PUT:
+                        (n,) = struct.unpack("<I", _recv_exact(conn, 4))
+                        payload = _recv_exact(conn, n)
+                        ok = self.queue.put(_decode(payload))
+                        conn.sendall(_ST_OK if ok else _ST_NO)
+                    elif op == _OP_GET:
+                        item = self.queue.get()
+                        if item is EMPTY:
+                            conn.sendall(_ST_NO)
+                        else:
+                            payload = _encode(item)
+                            conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
+                    elif op == _OP_SIZE:
+                        conn.sendall(_ST_OK + struct.pack("<I", self.queue.size()))
+                    elif op == _OP_CLOSE:
+                        self.queue.close()
+                        conn.sendall(_ST_OK)
+                    else:
+                        conn.sendall(_ST_ERR)
+                        return
+                except TransportClosed:
+                    conn.sendall(_ST_CLOSED)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpQueueClient:
+    """Client with the transport contract (put/get/size/get_wait/...)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.host, self.port = host, port
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    # -- contract ---------------------------------------------------------
+    def put(self, item: Any) -> bool:
+        payload = _encode(item)
+        with self._lock:
+            self._sock.sendall(_OP_PUT + struct.pack("<I", len(payload)) + payload)
+            return self._status() == _ST_OK
+
+    def get(self) -> Any:
+        with self._lock:
+            self._sock.sendall(_OP_GET)
+            st = self._status()
+            if st == _ST_NO:
+                return EMPTY
+            (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return _decode(_recv_exact(self._sock, n))
+
+    def size(self) -> int:
+        with self._lock:
+            self._sock.sendall(_OP_SIZE)
+            st = self._status()
+            (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return n
+
+    def close_remote(self):
+        """Close the remote queue (fault-injection / teardown)."""
+        with self._lock:
+            self._sock.sendall(_OP_CLOSE)
+            self._status()
+
+    # -- blocking helpers (same surface as RingBuffer) --------------------
+    def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.001) -> Any:
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            item = self.get()
+            if item is not EMPTY:
+                return item
+            if deadline is not None and time.monotonic() >= deadline:
+                return EMPTY
+            time.sleep(poll_s)
+
+    def put_wait(self, item: Any, timeout: Optional[float] = None, poll_s: float = 0.001) -> bool:
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.put(item):
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def get_batch(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
+        out = []
+        first = self.get_wait(timeout=timeout)
+        if first is EMPTY:
+            return out
+        out.append(first)
+        while len(out) < max_items:
+            item = self.get()
+            if item is EMPTY:
+                break
+            out.append(item)
+        return out
+
+    def disconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _status(self) -> bytes:
+        st = _recv_exact(self._sock, 1)
+        if st == _ST_CLOSED:
+            raise TransportClosed(f"remote queue at {self.host}:{self.port} is closed")
+        if st == _ST_ERR:
+            raise RuntimeError("protocol error")
+        return st

@@ -1,0 +1,3 @@
+"""Shared utilities: metrics, logging."""
+
+from psana_ray_tpu.utils.metrics import LatencyStats, Meter, PipelineMetrics  # noqa: F401

@@ -1,0 +1,130 @@
+"""Metrics: throughput meters, latency quantiles, queue-depth gauges.
+
+The reference's only observability is per-event log lines and an uncalled
+``Queue.size()`` (SURVEY.md §5 "Metrics: ... no metrics export, no
+counters"). This module provides the counters the runbook needs: frames/s,
+bytes/s, p50/p95/p99 latency (reservoir), queue depth snapshots.
+Thread-safe; pure stdlib.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Meter:
+    """Monotonic counter + windowed rate."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._lock = threading.Lock()
+        self._count = 0
+        self._t0 = time.monotonic()
+        self._window: List[tuple] = []  # (t, cumulative)
+
+    def add(self, n: int = 1):
+        with self._lock:
+            self._count += n
+            now = time.monotonic()
+            self._window.append((now, self._count))
+            cutoff = now - 10.0
+            while self._window and self._window[0][0] < cutoff:
+                self._window.pop(0)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def rate(self) -> float:
+        """Events/s over the trailing 10 s window (lifetime rate if the
+        window has <2 samples)."""
+        with self._lock:
+            if len(self._window) >= 2:
+                (t_a, c_a), (t_b, c_b) = self._window[0], self._window[-1]
+                if t_b > t_a:
+                    return (c_b - c_a) / (t_b - t_a)
+            dt = time.monotonic() - self._t0
+            return self._count / dt if dt > 0 else 0.0
+
+
+class LatencyStats:
+    """Reservoir-sampled latency quantiles (fixed memory, unbiased)."""
+
+    def __init__(self, reservoir_size: int = 4096, seed: int = 0):
+        self._lock = threading.Lock()
+        self._size = reservoir_size
+        self._n = 0
+        self._samples: List[float] = []
+        self._rng = random.Random(seed)
+
+    def observe(self, seconds: float):
+        with self._lock:
+            self._n += 1
+            if len(self._samples) < self._size:
+                self._samples.append(seconds)
+            else:
+                j = self._rng.randrange(self._n)
+                if j < self._size:
+                    self._samples[j] = seconds
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            s = sorted(self._samples)
+            idx = min(len(s) - 1, max(0, int(q * len(s))))
+            return s[idx]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def summary_ms(self) -> Dict[str, float]:
+        return {
+            "p50_ms": self.quantile(0.50) * 1e3,
+            "p95_ms": self.quantile(0.95) * 1e3,
+            "p99_ms": self.quantile(0.99) * 1e3,
+        }
+
+
+class PipelineMetrics:
+    """One bundle per producer/consumer process; renders a status line."""
+
+    def __init__(self, queue=None):
+        self.frames = Meter("frames")
+        self.bytes = Meter("bytes")
+        self.batches = Meter("batches")
+        self.step_latency = LatencyStats()
+        self._queue = queue
+
+    def observe_frame(self, nbytes: int = 0):
+        self.frames.add(1)
+        if nbytes:
+            self.bytes.add(nbytes)
+
+    def observe_batch(self, n_frames: int, latency_s: float, nbytes: int = 0):
+        self.batches.add(1)
+        self.frames.add(n_frames)
+        if nbytes:
+            self.bytes.add(nbytes)
+        self.step_latency.observe(latency_s)
+
+    def status_line(self) -> str:
+        lat = self.step_latency.summary_ms()
+        depth = ""
+        if self._queue is not None:
+            try:
+                depth = f" depth={self._queue.size()}"
+            except Exception:
+                depth = " depth=?"
+        gbps = self.bytes.rate() * 8 / 1e9
+        return (
+            f"frames={self.frames.count} ({self.frames.rate():.1f}/s, {gbps:.2f} Gbit/s)"
+            f" batches={self.batches.count}"
+            f" p50={lat['p50_ms']:.2f}ms p99={lat['p99_ms']:.2f}ms{depth}"
+        )

@@ -1,0 +1,107 @@
+"""Models: shapes, dtypes, padding-independence, losses."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psana_ray_tpu.models import PeakNetUNet, ResNet18, ResNet50, panels_to_nhwc
+from psana_ray_tpu.models.heads import nhwc_to_panels
+from psana_ray_tpu.models.losses import masked_sigmoid_focal, masked_softmax_xent
+
+
+class TestHeads:
+    def test_panels_to_channels(self):
+        x = jnp.arange(2 * 3 * 4 * 5.0).reshape(2, 3, 4, 5)
+        y = panels_to_nhwc(x, "channels")
+        assert y.shape == (2, 4, 5, 3)
+        np.testing.assert_array_equal(np.asarray(y[0, :, :, 1]), np.asarray(x[0, 1]))
+
+    def test_panels_to_batch_roundtrip(self):
+        x = jnp.arange(2 * 3 * 4 * 5.0).reshape(2, 3, 4, 5)
+        y = panels_to_nhwc(x, "batch")
+        assert y.shape == (6, 4, 5, 1)
+        np.testing.assert_array_equal(np.asarray(nhwc_to_panels(y, 3)), np.asarray(x))
+
+
+class TestResNet:
+    def test_resnet18_forward(self):
+        model = ResNet18(num_classes=2, width=16)
+        x = jnp.ones((2, 64, 64, 4))
+        vars_ = model.init(jax.random.key(0), x)
+        out = model.apply(vars_, x)
+        assert out.shape == (2, 2)
+        assert out.dtype == jnp.float32  # logits in f32
+
+    def test_resnet50_param_count(self):
+        # full-width ResNet-50: ~25.6M params in the torchvision layout;
+        # ours differs (GroupNorm, SiLU, panel channels) but must be same
+        # order: check the 4-stage bottleneck structure produced ~23-30M
+        model = ResNet50(num_classes=2, width=64)
+        vars_ = jax.eval_shape(
+            model.init, jax.random.key(0), jnp.ones((1, 224, 224, 3), jnp.float32)
+        )
+        n = sum(np.prod(v.shape) for v in jax.tree.leaves(vars_))
+        assert 20e6 < n < 32e6, f"param count {n/1e6:.1f}M out of ResNet-50 range"
+
+    def test_rows_independent(self):
+        # GroupNorm: padded rows must not change real rows' logits
+        model = ResNet18(num_classes=2, width=16)
+        real = jnp.asarray(np.random.default_rng(0).normal(size=(1, 64, 64, 4)), jnp.float32)
+        vars_ = model.init(jax.random.key(0), jnp.zeros((2, 64, 64, 4)))
+        alone = model.apply(vars_, real)
+        padded = model.apply(vars_, jnp.concatenate([real, jnp.zeros_like(real)]))
+        np.testing.assert_allclose(np.asarray(alone[0]), np.asarray(padded[0]), atol=2e-2)
+
+
+class TestUNet:
+    def test_forward_shape(self):
+        model = PeakNetUNet(features=(8, 16, 32), num_classes=1)
+        x = jnp.ones((2, 64, 96, 1))
+        vars_ = model.init(jax.random.key(0), x)
+        out = model.apply(vars_, x)
+        assert out.shape == (2, 64, 96, 1)
+        assert out.dtype == jnp.float32
+
+    def test_epix_panel_geometry(self):
+        # epix10k2M panel 352x384 through depth-4 U-Net (divisible by 8)
+        model = PeakNetUNet(features=(4, 8, 16, 32))
+        x = jnp.ones((1, 352, 384, 1))
+        out = model.apply(model.init(jax.random.key(0), x), x)
+        assert out.shape == (1, 352, 384, 1)
+
+    def test_panel_as_batch_path(self):
+        frames = jnp.ones((2, 4, 32, 64))  # [B,P,H,W]
+        x = panels_to_nhwc(frames, "batch")
+        model = PeakNetUNet(features=(4, 8))
+        out = model.apply(model.init(jax.random.key(0), x), x)
+        masks = nhwc_to_panels(out, 4)
+        assert masks.shape == (2, 4, 32, 64)
+
+
+class TestLosses:
+    def test_xent_ignores_padding(self):
+        logits = jnp.asarray([[10.0, -10.0], [0.0, 0.0], [-5.0, 5.0]])
+        labels = jnp.asarray([0, 1, 0])
+        full = masked_softmax_xent(logits, labels, jnp.asarray([1, 1, 0]))
+        sub = masked_softmax_xent(logits[:2], labels[:2], jnp.asarray([1, 1]))
+        assert float(full) == pytest.approx(float(sub))
+
+    def test_xent_all_padded_finite(self):
+        out = masked_softmax_xent(jnp.ones((2, 3)), jnp.zeros((2,), jnp.int32), jnp.zeros((2,)))
+        assert np.isfinite(float(out))
+
+    def test_focal_downweights_easy(self):
+        t = jnp.zeros((1, 8, 8, 1))
+        easy = jnp.full((1, 8, 8, 1), -9.0)  # confident background
+        hard = jnp.full((1, 8, 8, 1), 0.0)
+        assert float(masked_sigmoid_focal(easy, t)) < float(masked_sigmoid_focal(hard, t))
+
+    def test_focal_padding(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(3, 4, 4, 1)), jnp.float32)
+        targets = jnp.asarray(rng.random((3, 4, 4, 1)) < 0.1, jnp.float32)
+        full = masked_sigmoid_focal(logits, targets, jnp.asarray([1, 1, 0]))
+        sub = masked_sigmoid_focal(logits[:2], targets[:2], jnp.asarray([1, 1]))
+        assert float(full) == pytest.approx(float(sub), rel=1e-5)

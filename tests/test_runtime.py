@@ -1,0 +1,170 @@
+"""Producer runtime + DataReader client: rendezvous, backpressure,
+barrier-then-EOS ordering, max_steps, masking, fault detection, metrics."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.config import (
+    MaskConfig,
+    PipelineConfig,
+    RetrievalMode,
+    SourceConfig,
+    TransportConfig,
+)
+from psana_ray_tpu.consumer import DataReader, DataReaderError
+from psana_ray_tpu.producer import ProducerRuntime, parse_arguments
+from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
+from psana_ray_tpu.transport import Registry, RingBuffer
+
+
+def _config(num_events=12, num_consumers=1, detector="epix100", **src_kw):
+    return PipelineConfig(
+        source=SourceConfig(
+            exp="synthetic", run=1, detector_name=detector, num_events=num_events, **src_kw
+        ),
+        transport=TransportConfig(num_consumers=num_consumers, queue_size=64),
+    )
+
+
+class TestProducerRuntime:
+    def test_end_to_end_all_events_then_eos(self):
+        cfg = _config(num_events=10)
+        rt = ProducerRuntime(cfg, num_local_shards=2)
+        rt.run(block=False)
+
+        got, eos = [], []
+        with DataReader() as reader:
+            while True:
+                item = reader.read_wait(timeout=5.0)
+                if item is None:
+                    pytest.fail("starved before EOS")
+                if is_eos(item):
+                    eos.append(item)
+                    break
+                got.append(item)
+        rt.join()
+        # every event exactly once, EOS strictly after all data
+        assert sorted(r.event_idx for r in got) == list(range(10))
+        assert len(eos) == 1
+        assert rt.metrics.frames.count == 10
+
+    def test_eos_per_consumer(self):
+        cfg = _config(num_events=4, num_consumers=3)
+        rt = ProducerRuntime(cfg, num_local_shards=1)
+        rt.run(block=True)
+        q = Registry.default().resolve("default", "shared_queue", retries=1, interval_s=0.1)
+        items = [q.get_wait(timeout=1.0) for _ in range(7)]
+        assert sum(is_eos(i) for i in items) == 3  # parity: producer.py:124-125
+
+    def test_max_steps(self):
+        cfg = _config(num_events=100, max_steps=5)
+        rt = ProducerRuntime(cfg, num_local_shards=1)
+        rt.run(block=True)
+        assert rt.metrics.frames.count == 5
+
+    def test_mask_applied_host_side(self, tmp_path):
+        # parity: np.where(mask, data, 0), producer.py:92-95
+        mask = np.zeros((1, 704, 768), np.uint8)  # all-bad manual mask
+        path = tmp_path / "mask.npy"
+        np.save(path, mask)
+        cfg = _config(num_events=2)
+        cfg = PipelineConfig(
+            source=cfg.source,
+            mask=MaskConfig(manual_mask_path=str(path)),
+            transport=cfg.transport,
+        )
+        rt = ProducerRuntime(cfg, num_local_shards=1)
+        rt.run(block=True)
+        with DataReader() as reader:
+            rec = reader.read_wait(timeout=2.0)
+        assert rec.panels.sum() == 0
+
+    def test_queue_death_mid_stream_exits_cleanly(self):
+        cfg = _config(num_events=5000, detector="epix100")
+        cfg.transport.queue_size = 2  # force backpressure so death is seen
+        rt = ProducerRuntime(cfg, num_local_shards=1)
+        q = rt.bootstrap()
+        rt.run(block=False)
+        time.sleep(0.2)
+        Registry.default().destroy("default", "shared_queue")  # kills queue
+        rt.join()  # must return, not raise/hang — parity: producer.py:112-114
+
+    def test_sharded_ranks_disjoint(self):
+        cfg = _config(num_events=9)
+        rt = ProducerRuntime(cfg, num_local_shards=3)
+        rt.run(block=True)
+        from psana_ray_tpu.transport import EMPTY
+
+        q = Registry.default().resolve("default", "shared_queue", retries=1, interval_s=0.1)
+        recs = [
+            i
+            for i in iter(lambda: q.get_wait(timeout=0.5), EMPTY)
+            if not is_eos(i)
+        ]
+        by_rank = {}
+        for r in recs:
+            by_rank.setdefault(r.shard_rank, []).append(r.event_idx)
+        assert set(by_rank) == {0, 1, 2}
+        assert sorted(sum(by_rank.values(), [])) == list(range(9))
+
+
+class TestDataReaderParity:
+    def test_context_manager_and_nonblocking_read(self):
+        Registry.default().get_or_create("default", "shared_queue", lambda: RingBuffer(8))
+        with DataReader() as reader:
+            assert reader.read() is None  # empty, parity data_reader.py:35
+            assert reader.size() == 0
+
+    def test_missing_queue_raises_reader_error(self):
+        cfg = TransportConfig(rendezvous_retries=2, rendezvous_interval_s=0.01)
+        with pytest.raises(DataReaderError, match="could not find"):
+            DataReader(queue_name="nope", config=cfg).connect()
+
+    def test_dead_queue_maps_to_reader_error(self):
+        q = Registry.default().get_or_create("default", "shared_queue", lambda: RingBuffer(8))
+        reader = DataReader().connect()
+        q.close()
+        with pytest.raises(DataReaderError):
+            reader.read()
+
+    def test_unconnected_read_raises(self):
+        with pytest.raises(DataReaderError, match="not connected"):
+            DataReader().read()
+
+    def test_iteration_stops_at_eos(self):
+        q = Registry.default().get_or_create("default", "shared_queue", lambda: RingBuffer(16))
+        for i in range(3):
+            q.put(FrameRecord(0, i, np.zeros((1, 2, 2), np.float32), 1.0))
+        q.put(EndOfStream())
+        with DataReader() as reader:
+            seen = [r.event_idx for r in reader]
+        assert seen == [0, 1, 2]
+
+
+class TestCLI:
+    def test_reference_flag_spellings(self):
+        cfg, args = parse_arguments(
+            [
+                "--exp", "synthetic", "--run", "58", "--detector_name", "epix10k2M",
+                "--calib", "--uses_bad_pixel_mask", "--queue_name", "q1",
+                "--queue_size", "400", "--num_consumers", "4", "--max_steps", "100",
+                "--ray_namespace", "ns", "--log_level", "DEBUG",
+            ]
+        )
+        assert cfg.source.run == 58
+        assert cfg.source.mode == RetrievalMode.CALIB
+        assert cfg.mask.uses_bad_pixel_mask
+        assert cfg.transport.queue_size == 400
+        assert cfg.transport.num_consumers == 4
+        assert cfg.transport.namespace == "ns"
+        assert cfg.source.max_steps == 100
+
+    def test_defaults_rendezvous_with_data_reader(self):
+        # quirk 3 fixed: producer and DataReader share ONE default surface
+        cfg, _ = parse_arguments([])
+        reader = DataReader()
+        assert cfg.transport.queue_name == reader.queue_name
+        assert cfg.transport.namespace == reader.namespace

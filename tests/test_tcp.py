@@ -1,0 +1,92 @@
+"""TCP queue transport: contract parity over a real socket, frame payloads,
+concurrent producers/consumers, remote close propagation."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
+from psana_ray_tpu.transport import EMPTY, TransportClosed
+from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+
+@pytest.fixture
+def server():
+    s = TcpQueueServer(host="127.0.0.1", maxsize=8).serve_background()
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture
+def client(server):
+    c = TcpQueueClient("127.0.0.1", server.port)
+    yield c
+    c.disconnect()
+
+
+class TestContract:
+    def test_fifo_roundtrip(self, client):
+        assert client.get() is EMPTY
+        assert client.put({"x": 1})
+        assert client.put([1, 2])
+        assert client.size() == 2
+        assert client.get() == {"x": 1}
+        assert client.get() == [1, 2]
+
+    def test_full_backpressure(self, client):
+        n = 0
+        while client.put(n):
+            n += 1
+        assert n == 8
+        assert client.get() == 0
+
+    def test_frame_payload(self, client):
+        panels = np.arange(2 * 4 * 8, dtype=np.float32).reshape(2, 4, 8)
+        client.put(FrameRecord(1, 7, panels, 8.8))
+        out = client.get()
+        assert isinstance(out, FrameRecord)
+        np.testing.assert_array_equal(out.panels, panels)
+        client.put(EndOfStream(total_events=1))
+        assert is_eos(client.get())
+
+    def test_remote_close_propagates(self, server, client):
+        other = TcpQueueClient("127.0.0.1", server.port)
+        client.close_remote()
+        with pytest.raises(TransportClosed):
+            other.get()
+        with pytest.raises(TransportClosed):
+            other.put(1)
+        other.disconnect()
+
+    def test_get_wait_timeout(self, client):
+        t0 = time.monotonic()
+        assert client.get_wait(timeout=0.05) is EMPTY
+        assert time.monotonic() - t0 >= 0.04
+
+
+class TestConcurrent:
+    def test_multiple_clients_stream(self, server):
+        n = 40
+
+        def producer(rank):
+            c = TcpQueueClient("127.0.0.1", server.port)
+            for i in range(rank, n, 2):
+                rec = FrameRecord(rank, i, np.full((1, 4, 4), float(i), np.float32), 1.0)
+                c.put_wait(rec, timeout=10)
+            c.disconnect()
+
+        threads = [threading.Thread(target=producer, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        consumer = TcpQueueClient("127.0.0.1", server.port)
+        got = []
+        while len(got) < n:
+            item = consumer.get_wait(timeout=5.0)
+            assert item is not EMPTY, "starved"
+            got.append(item)
+        for t in threads:
+            t.join()
+        consumer.disconnect()
+        assert sorted(r.event_idx for r in got) == list(range(n))

@@ -81,6 +81,11 @@ class TransportConfig:
     # consumer poll interval when starved (reference hardcodes 1 s,
     # psana_consumer.py:40 — far too coarse; default 10 ms here)
     poll_interval_s: float = 0.01
+    # producer-side frames per wire round trip on transports with batched
+    # puts (TCP): 1 = per-event puts (the reference's per-event RPC,
+    # producer.py:101, survives only on in-process/shm paths where a put
+    # is a memcpy, not a round trip)
+    put_batch_size: int = 16
 
 
 @dataclasses.dataclass

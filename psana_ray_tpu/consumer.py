@@ -22,8 +22,8 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from psana_ray_tpu.config import TransportConfig
-from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
-from psana_ray_tpu.transport import EMPTY, Registry, RendezvousTimeout, TransportClosed
+from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord, is_eos
+from psana_ray_tpu.transport import EMPTY, RendezvousTimeout, TransportClosed
 
 
 class DataReaderError(RuntimeError):
@@ -48,25 +48,15 @@ class DataReader:
     def connect(self) -> "DataReader":
         if self._queue is not None:
             return self
+        import dataclasses
+
+        from psana_ray_tpu.transport.addressing import open_queue
+
+        cfg = dataclasses.replace(
+            self.config, queue_name=self.queue_name, namespace=self.namespace
+        )
         try:
-            if self.address in ("auto", "local"):
-                self._queue = Registry.default().resolve(
-                    self.namespace,
-                    self.queue_name,
-                    retries=self.config.rendezvous_retries,
-                    interval_s=self.config.rendezvous_interval_s,
-                )
-            elif self.address.startswith("tcp://"):
-                from psana_ray_tpu.transport.tcp import TcpQueueClient
-
-                host, _, port = self.address[len("tcp://"):].partition(":")
-                self._queue = TcpQueueClient(host, int(port))
-            elif self.address.startswith("shm://"):
-                from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
-
-                self._queue = ShmRingBuffer.attach(self.address[len("shm://"):])
-            else:
-                raise ValueError(f"unknown address scheme {self.address!r}")
+            self._queue = open_queue(cfg, role="consumer", address=self.address)
         except RendezvousTimeout as e:
             raise DataReaderError(f"could not find queue {self.queue_name!r}: {e}") from e
         return self
@@ -111,16 +101,40 @@ class DataReader:
             raise DataReaderError(str(e)) from e
 
     def __iter__(self):
-        """Iterate FrameRecords until EOS (the loop the reference's example
-        couldn't write correctly — psana_consumer.py:38-40 spins forever)."""
+        """Iterate FrameRecords until the stream completes (the loop the
+        reference's example couldn't write correctly — psana_consumer.py:
+        38-40 spins forever)."""
+        return self.iter_records()
+
+    def iter_records(self, stop=None):
+        """Yield FrameRecords until the stream completes or ``stop()``
+        returns True (checked between reads, so breaking never discards a
+        frame a sibling consumer could have processed).
+
+        With multiple producer runtimes feeding one queue, stops only once
+        EOS markers cover every global shard (:class:`EosTally`); duplicate
+        markers destined for sibling consumers are held and returned to
+        the queue (never dropped, even against a momentarily full queue)."""
         self._check_connected()
-        while True:
-            item = self.read_wait(timeout=1.0)
-            if item is None:
-                continue
-            if is_eos(item):
-                return
-            yield item
+        tally = EosTally()
+        try:
+            while not (stop is not None and stop()):
+                item = self.read_wait(timeout=1.0)
+                if item is None:
+                    # starved while holding a sibling's marker: put it back
+                    # NOW — two consumers each holding the marker the other
+                    # needs would otherwise deadlock, both waiting on an
+                    # empty queue with flush gated on a successful read
+                    tally.flush_duplicates(self._queue)
+                    continue
+                tally.flush_duplicates(self._queue)  # a slot just freed
+                if is_eos(item):
+                    if tally.process(item):
+                        return
+                    continue
+                yield item
+        finally:
+            tally.flush_duplicates(self._queue, final=True)
 
     def size(self) -> int:
         self._check_connected()
@@ -132,3 +146,60 @@ class DataReader:
     def _check_connected(self):
         if self._queue is None:
             raise DataReaderError("not connected — call connect() or use as context manager")
+
+
+def main(argv=None):
+    """Console consumer — the reference example (``psana_consumer.py:49-55``)
+    as an installed entry point, with typed EOS termination."""
+    import argparse
+    import logging
+    import signal
+
+    p = argparse.ArgumentParser(prog="psana-ray-tpu-consumer")
+    p.add_argument("consumer_id", type=int, nargs="?", default=0)
+    p.add_argument("--ray_address", "--address", dest="address", default="auto")
+    p.add_argument("--ray_namespace", "--namespace", dest="namespace", default="default")
+    p.add_argument("--queue_name", default="shared_queue")
+    p.add_argument("--max_frames", type=int, default=None)
+    p.add_argument("--quiet", action="store_true", help="suppress per-frame lines")
+    p.add_argument("--log_level", default="INFO")
+    a = p.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, a.log_level.upper(), logging.INFO),
+        format="%(asctime)s - %(levelname)s - %(message)s",
+    )
+    log = logging.getLogger("consumer")
+
+    stop = False
+
+    def _sigint(sig, frame):  # parity: psana_consumer.py:24-26
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGINT, _sigint)
+    n = 0
+
+    def _should_stop():
+        # checked between reads: breaking never discards an already-read
+        # frame, and SIGINT exits even while starved (no yield to reach)
+        return stop or (a.max_frames is not None and n >= a.max_frames)
+
+    try:
+        with DataReader(address=a.address, queue_name=a.queue_name, namespace=a.namespace) as reader:
+            for rec in reader.iter_records(stop=_should_stop):
+                n += 1
+                if not a.quiet:
+                    log.info(
+                        "consumer %d: rank=%d idx=%d shape=%s energy=%.2f",
+                        a.consumer_id, rec.shard_rank, rec.event_idx,
+                        rec.panels.shape, rec.photon_energy,
+                    )
+        log.info("consumer %d: end of stream after %d frames", a.consumer_id, n)
+    except DataReaderError as e:  # parity: psana_consumer.py:41-44
+        log.error("consumer %d: queue is dead (%s); exiting", a.consumer_id, e)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,7 +17,9 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from psana_ray_tpu.records import EndOfStream, FrameRecord
+from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord
+from psana_ray_tpu.transport.recovery import return_to_queue
+from psana_ray_tpu.transport.registry import TransportClosed
 
 
 @dataclasses.dataclass
@@ -114,30 +116,66 @@ def batches_from_queue(
     """Drain a transport queue into fixed-shape batches until EOS.
 
     Uses ``get_batch`` (one lock acquisition for many items) rather than the
-    reference's one-RPC-per-event read (``data_reader.py:35``). On EOS the
-    tail is flushed padded; iteration then stops. ``max_wait_s`` bounds total
-    starvation (None = wait forever, matching the reference consumer loop).
+    reference's one-RPC-per-event read (``data_reader.py:35``). On stream
+    completion the tail is flushed padded; iteration then stops.
+    ``max_wait_s`` bounds total starvation (None = wait forever, matching
+    the reference consumer loop).
+
+    Multiple producer runtimes may feed one queue, each emitting its own
+    EOS (no global MPI barrier here, unlike reference ``producer.py:
+    119-126``); an :class:`EosTally` stops iteration only once every
+    global shard is covered, and duplicate markers (copies meant for
+    sibling consumers) are re-enqueued.
     """
     batcher: Optional[FrameBatcher] = None
     starved_since: Optional[float] = None
-    while True:
-        items = queue.get_batch(batch_size, timeout=poll_interval_s)
-        if not items:
-            now = time.monotonic()
-            starved_since = starved_since if starved_since is not None else now
-            if max_wait_s is not None and now - starved_since >= max_wait_s:
+    tally = EosTally()
+    try:
+        while True:
+            try:
+                items = queue.get_batch(batch_size, timeout=poll_interval_s)
+            except TransportClosed:
+                # transport died mid-stream: deliver what we already hold
+                # (reference dead-queue parity = clean exit, producer.py:112-114)
                 if batcher is not None and (tail := batcher.flush()) is not None:
                     yield tail
                 return
-            continue
-        starved_since = None
-        for item in items:
-            if isinstance(item, EndOfStream):
-                if batcher is not None and (tail := batcher.flush()) is not None:
-                    yield tail
-                return
-            if batcher is None:
-                batcher = FrameBatcher(batch_size)
-            out = batcher.push(item)
-            if out is not None:
-                yield out
+            if not items:
+                # starved: return any held sibling markers (cross-holding
+                # consumers would otherwise deadlock — see iter_records)
+                tally.flush_duplicates(queue)
+                now = time.monotonic()
+                starved_since = starved_since if starved_since is not None else now
+                if max_wait_s is not None and now - starved_since >= max_wait_s:
+                    if batcher is not None and (tail := batcher.flush()) is not None:
+                        yield tail
+                    return
+                continue
+            starved_since = None
+            tally.flush_duplicates(queue)  # gets just freed slots
+            for pos, item in enumerate(items):
+                if isinstance(item, EndOfStream):
+                    if tally.process(item):
+                        # items after the completing marker were already
+                        # popped; hand them to the tally (sibling EOS
+                        # copies) or back to the queue so nothing this
+                        # consumer holds is silently dropped
+                        leftover_frames = []
+                        for rest in items[pos + 1:]:
+                            if isinstance(rest, EndOfStream):
+                                tally.process(rest)
+                            else:
+                                leftover_frames.append(rest)
+                        if leftover_frames:
+                            return_to_queue(queue, leftover_frames, what="re-popped record")
+                        if batcher is not None and (tail := batcher.flush()) is not None:
+                            yield tail
+                        return
+                    continue
+                if batcher is None:
+                    batcher = FrameBatcher(batch_size)
+                out = batcher.push(item)
+                if out is not None:
+                    yield out
+    finally:
+        tally.flush_duplicates(queue, final=True)

@@ -13,12 +13,14 @@ from __future__ import annotations
 import dataclasses
 import queue as _queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Optional
 
 import jax
 import numpy as np
 
 from psana_ray_tpu.infeed.batcher import Batch, batches_from_queue
+from psana_ray_tpu.utils.metrics import PipelineMetrics
 
 
 class DevicePrefetcher:
@@ -131,9 +133,11 @@ class InfeedPipeline:
         prefetch_depth: int = 2,
         poll_interval_s: float = 0.01,
         max_wait_s: Optional[float] = None,
+        metrics: Optional[PipelineMetrics] = None,
     ):
         self.queue = queue
         self.batch_size = batch_size
+        self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
         self._batches = batches_from_queue(
             queue, batch_size, poll_interval_s=poll_interval_s, max_wait_s=max_wait_s
         )
@@ -153,16 +157,32 @@ class InfeedPipeline:
     def __exit__(self, *exc):
         self.close()
 
-    def run(self, step: Callable[[Batch], Any], on_result: Optional[Callable] = None) -> int:
+    def run(
+        self,
+        step: Callable[[Batch], Any],
+        on_result: Optional[Callable] = None,
+        block_until_ready: bool = False,
+    ) -> int:
         """Drive ``step`` over every batch until EOS; returns frames seen.
 
         ``step`` receives device-resident Batches; results are handed to
-        ``on_result`` (if given) without forcing synchronization. The
+        ``on_result`` (if given) without forcing synchronization unless
+        ``block_until_ready`` is set (which makes ``metrics.step_latency``
+        a true per-batch device latency instead of dispatch time — the
+        honest number for the <5 ms p50 target, BASELINE.md). The
         prefetcher is closed on exit, normal or not."""
         n = 0
         try:
             for batch in self:
+                t0 = time.monotonic()
                 out = step(batch)
+                if block_until_ready:
+                    out = jax.block_until_ready(out)
+                self.metrics.observe_batch(
+                    batch.num_valid,
+                    time.monotonic() - t0,
+                    nbytes=int(getattr(batch.frames, "nbytes", 0)),
+                )
                 n += batch.num_valid
                 if on_result is not None:
                     on_result(out, batch)

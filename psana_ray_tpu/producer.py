@@ -29,10 +29,57 @@ import numpy as np
 from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, SourceConfig, TransportConfig
 from psana_ray_tpu.records import EndOfStream, FrameRecord
 from psana_ray_tpu.sources import open_source
-from psana_ray_tpu.transport import BackoffPolicy, Registry, RingBuffer, TransportClosed
+from psana_ray_tpu.transport import BackoffPolicy, Registry, TransportClosed
+from psana_ray_tpu.transport.addressing import open_queue
 from psana_ray_tpu.utils.metrics import PipelineMetrics
 
 logger = logging.getLogger(__name__)
+
+
+class _Sender:
+    """Backpressured frame sender; batches puts on transports that support
+    ``put_batch`` (TCP) so the cross-host path pays one round trip per N
+    frames instead of the reference's one RPC per event (``producer.py:
+    101``, SURVEY.md §3.1). In-process/shm puts are memcpys — those stay
+    per-event (batch size 1)."""
+
+    def __init__(self, queue, backoff, stop_event, metrics, batch_size: int = 16):
+        self.queue = queue
+        self.backoff = backoff
+        self.stop = stop_event
+        self.metrics = metrics
+        self.batch_size = batch_size if hasattr(queue, "put_batch") else 1
+        self.pending: List[FrameRecord] = []
+
+    def send(self, rec) -> bool:
+        """Buffer + flush when full. False = transport closed/stopped."""
+        self.pending.append(rec)
+        if len(self.pending) >= self.batch_size:
+            return self.flush()
+        return True
+
+    def flush(self) -> bool:
+        """Drain the buffer with the backpressure envelope (parity:
+        producer.py:106-111). False = transport closed/stopped (records
+        may remain pending — the stream is dead either way)."""
+        while self.pending:
+            if self.stop.is_set():
+                return False
+            try:
+                if self.batch_size > 1:
+                    accepted = self.queue.put_batch(self.pending)
+                else:
+                    accepted = 1 if self.queue.put(self.pending[0]) else 0
+            except TransportClosed:
+                return False
+            if accepted:
+                for r in self.pending[:accepted]:
+                    self.metrics.observe_frame(r.nbytes)
+                del self.pending[:accepted]
+                self.backoff.reset()
+            else:
+                self.backoff.wait()
+        return True
 
 
 class ProducerRuntime:
@@ -61,10 +108,11 @@ class ProducerRuntime:
     # -- rendezvous (parity: producer.py:35-71) ---------------------------
     def bootstrap(self):
         t = self.config.transport
-        self._queue = self.registry.get_or_create(
-            t.namespace, t.queue_name, lambda: RingBuffer(t.queue_size, name=t.queue_name)
+        self._queue = open_queue(t, role="producer", registry=self.registry)
+        logger.info(
+            "queue %r ready (namespace=%r address=%r size=%d)",
+            t.queue_name, t.namespace, t.address, t.queue_size,
         )
-        logger.info("queue %r ready (namespace=%r size=%d)", t.queue_name, t.namespace, t.queue_size)
         return self._queue
 
     # -- per-shard event pump (parity: produce_data, producer.py:78-130) --
@@ -85,10 +133,11 @@ class ProducerRuntime:
             )
             mask = self._load_mask(source)
             backoff = BackoffPolicy(t.backoff_base_s, t.backoff_cap_s, t.backoff_jitter_s)
+            sender = _Sender(
+                self._queue, backoff, self._stop, self.metrics, t.put_batch_size
+            )
             produced = 0
-            for idx, (data, energy) in zip(
-                source.shard_event_indices(), source.iter_events(cfg.source.mode)
-            ):
+            for idx, data, energy in source.iter_indexed_events(cfg.source.mode):
                 if self._stop.is_set():
                     break
                 if cfg.source.max_steps is not None and produced >= cfg.source.max_steps:
@@ -97,22 +146,17 @@ class ProducerRuntime:
                 if mask is not None:
                     data = np.where(mask, data, 0)  # parity: producer.py:92-95
                 rec = FrameRecord(rank, int(idx), data, energy, timestamp=time.time())
-                while not self._stop.is_set():
-                    try:
-                        if self._queue.put(rec):
-                            backoff.reset()
-                            self.metrics.observe_frame(rec.nbytes)
-                            produced += 1
-                            logger.debug(
-                                "rank %d produced idx=%d shape=%s energy=%.2f",
-                                rank, idx, rec.panels.shape, energy,
-                            )
-                            break
-                        logger.debug("rank %d queue full; backoff", rank)
-                        backoff.wait()  # parity: producer.py:106-111
-                    except TransportClosed:
-                        logger.warning("rank %d: queue dead, exiting", rank)
-                        return  # parity: producer.py:112-114
+                if not sender.send(rec):
+                    logger.warning("rank %d: queue dead, exiting", rank)
+                    return  # parity: producer.py:112-114
+                produced += 1
+                logger.debug(
+                    "rank %d produced idx=%d shape=%s energy=%.2f",
+                    rank, idx, rec.panels.shape, energy,
+                )
+            if not sender.flush():  # tail of the batch buffer precedes EOS
+                logger.warning("rank %d: queue dead at flush, exiting", rank)
+                return
             # barrier so EOS follows ALL shards' data (parity: producer.py:120)
             self._barrier.wait(timeout=600)
             if local_idx == 0:
@@ -126,14 +170,22 @@ class ProducerRuntime:
                 pass
 
     def _emit_eos(self):
-        """Rank 0 puts one typed EOS per expected consumer
-        (parity: producer.py:121-126, tolerating a dead queue :127-130)."""
+        """Local rank 0 puts one typed EOS per expected consumer
+        (parity: producer.py:121-126, tolerating a dead queue :127-130).
+
+        The marker carries this runtime's shard coverage so consumers with
+        an :class:`EosTally` stop only when EVERY runtime feeding the queue
+        has finished — the role the reference's global MPI barrier played
+        (``producer.py:119-126``)."""
         t = self.config.transport
+        eos = EndOfStream(
+            producer_rank=self.shard_rank_offset,
+            shards_done=self.num_local_shards,
+            total_shards=self.total_shards,
+        )
         for _ in range(t.num_consumers):
             try:
-                while not self._queue.put_wait(
-                    EndOfStream(producer_rank=self.shard_rank_offset), timeout=5.0
-                ):
+                while not self._queue.put_wait(eos, timeout=5.0):
                     if self._stop.is_set():
                         return
             except TransportClosed:
@@ -192,13 +244,23 @@ def parse_arguments(argv=None):
     p.add_argument("--log_level", default="INFO")
     p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
     p.add_argument("--num_events", type=int, default=1024, help="synthetic events")
+    p.add_argument(
+        "--shard_rank_offset", type=int, default=None,
+        help="global shard offset of this process (default: auto from MPI/SLURM env)",
+    )
+    p.add_argument(
+        "--total_shards", type=int, default=None,
+        help="global shard count across all producer processes (default: auto)",
+    )
     a = p.parse_args(argv)
     return PipelineConfig(
         source=SourceConfig(
             exp=a.exp,
             run=a.run,
             detector_name=a.detector_name,
-            mode=RetrievalMode.CALIB if a.calib else RetrievalMode.RAW,
+            # reference parity: absence of --calib selects assembled-image
+            # mode, not raw ADUs (reference producer.py:156-159)
+            mode=RetrievalMode.CALIB if a.calib else RetrievalMode.IMAGE,
             max_steps=a.max_steps,
             num_events=a.num_events,
         ),
@@ -213,13 +275,54 @@ def parse_arguments(argv=None):
     ), a
 
 
+def detect_process_rank() -> tuple:
+    """(process_rank, world_size) from the launcher environment.
+
+    The reference gets these from ``MPI.COMM_WORLD`` (``producer.py:
+    138-140``); here they come from the env vars every common launcher
+    exports (Open MPI, MPICH/PMI, Slurm), so ``mpirun -n 4
+    psana-ray-tpu-producer ...`` shards rank-derived with no mpi4py."""
+    import os
+
+    for rank_var, size_var in (
+        ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+        ("PMI_RANK", "PMI_SIZE"),
+        ("SLURM_PROCID", "SLURM_NTASKS"),
+    ):
+        if rank_var in os.environ:
+            return int(os.environ[rank_var]), int(os.environ.get(size_var, 1))
+    return 0, 1
+
+
+def shard_topology(args) -> tuple:
+    """(shard_rank_offset, total_shards) for this process: explicit flags
+    win; otherwise derived from the launcher rank/size so N processes x
+    ``--num_shards`` local workers tile the global event space."""
+    rank, world = detect_process_rank()
+    offset = (
+        args.shard_rank_offset
+        if args.shard_rank_offset is not None
+        else rank * args.num_shards
+    )
+    total = (
+        args.total_shards if args.total_shards is not None else world * args.num_shards
+    )
+    return offset, total
+
+
 def main(argv=None):
     config, args = parse_arguments(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format=config.log.fmt,  # parity: producer.py:135-136
     )
-    runtime = ProducerRuntime(config, num_local_shards=args.num_shards)
+    offset, total = shard_topology(args)
+    runtime = ProducerRuntime(
+        config,
+        num_local_shards=args.num_shards,
+        shard_rank_offset=offset,
+        total_shards=total,
+    )
 
     def _sigint(signum, frame):  # parity: producer.py:73-76,142-143
         logger.info("SIGINT — stopping producer")

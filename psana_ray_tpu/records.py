@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # Wire format magics (little-endian u32).
 _FRAME_MAGIC = 0x50525446  # "PRTF" — psana-ray-tpu frame
@@ -29,7 +29,9 @@ _EOS_MAGIC = 0x50525445  # "PRTE" — psana-ray-tpu EOS
 
 # header: magic, version, shard_rank, event_idx, ndim, dtype_code, photon_energy(f64), timestamp(f64)
 _FRAME_HEADER = struct.Struct("<IIqqII d d")
-_EOS_HEADER = struct.Struct("<IIqq")
+_EOS_HEADER_V1 = struct.Struct("<IIqq")
+# v2 appends shards_done + total_shards (multi-producer EOS aggregation)
+_EOS_HEADER = struct.Struct("<IIqqqq")
 
 _DTYPE_CODES = {
     np.dtype(np.float32): 0,
@@ -133,21 +135,120 @@ class EndOfStream:
     which was indistinguishable from "queue momentarily empty"
     (``shared_queue.py:21``). ``producer_rank`` records who signalled;
     ``total_events`` (when known) lets consumers verify completeness.
+
+    The reference coordinates N producer processes with a global MPI
+    barrier before a single rank-0 sentinel emission (``producer.py:
+    119-126``). Without MPI, each producer runtime emits its own EOS
+    carrying how many shards it covered (``shards_done``) out of how many
+    exist globally (``total_shards``); consumers tally markers with
+    :class:`EosTally` and stop only once every shard is accounted for.
     """
 
     producer_rank: int = 0
     total_events: int = -1  # -1 = unknown
+    shards_done: int = 1  # shards covered by the emitting runtime
+    total_shards: int = 1  # global shard count across all runtimes
     schema_version: int = SCHEMA_VERSION
 
     def to_bytes(self) -> bytes:
-        return _EOS_HEADER.pack(_EOS_MAGIC, self.schema_version, self.producer_rank, self.total_events)
+        return _EOS_HEADER.pack(
+            _EOS_MAGIC,
+            self.schema_version,
+            self.producer_rank,
+            self.total_events,
+            self.shards_done,
+            self.total_shards,
+        )
 
     @staticmethod
     def from_bytes(buf: bytes) -> "EndOfStream":
-        magic, version, rank, total = _EOS_HEADER.unpack_from(buf, 0)
+        magic, version, rank, total = _EOS_HEADER_V1.unpack_from(buf, 0)
         if magic != _EOS_MAGIC:
             raise ValueError(f"bad EOS magic {magic:#x}")
-        return EndOfStream(producer_rank=rank, total_events=total, schema_version=version)
+        shards_done = total_shards = 1
+        if version >= 2:
+            off = _EOS_HEADER_V1.size
+            shards_done, total_shards = struct.unpack_from("<qq", buf, off)
+        return EndOfStream(
+            producer_rank=rank,
+            total_events=total,
+            shards_done=shards_done,
+            total_shards=total_shards,
+            schema_version=version,
+        )
+
+
+class EosTally:
+    """Tracks EOS markers from multiple producer runtimes.
+
+    ``observe(eos)`` returns True once every global shard is covered —
+    i.e. the sum of ``shards_done`` over distinct producer ranks reaches
+    ``total_shards``. ``is_duplicate(eos)`` tells a consumer that it
+    already holds this runtime's marker — the copy belongs to a sibling
+    consumer (each runtime emits one marker per expected consumer, parity
+    with reference ``producer.py:124-125``).
+
+    :meth:`process` + :meth:`flush_duplicates` are the shared consumer-side
+    protocol: duplicates are *held* (never dropped) and returned to the
+    queue when space is available — re-enqueueing inline could fail against
+    a full queue and silently starve the sibling.
+    """
+
+    def __init__(self):
+        self._shards_by_rank = {}
+        self._total = 1
+        self._pending_dups: list = []
+
+    def is_duplicate(self, eos: "EndOfStream") -> bool:
+        return eos.producer_rank in self._shards_by_rank
+
+    def observe(self, eos: "EndOfStream") -> bool:
+        self._shards_by_rank[eos.producer_rank] = eos.shards_done
+        self._total = max(self._total, eos.total_shards)
+        return self.complete
+
+    def process(self, eos: "EndOfStream") -> bool:
+        """Observe a marker read off the queue; duplicate copies are held
+        for :meth:`flush_duplicates`. Returns True when the stream is
+        complete (every global shard covered)."""
+        if self.is_duplicate(eos):
+            self._pending_dups.append(eos)
+            return self.complete
+        return self.observe(eos)
+
+    def flush_duplicates(self, queue, final: bool = False) -> None:
+        """Return held sibling markers to ``queue``. Cheap no-op when none
+        pend. Call after reads (a get just freed a slot) and once more on
+        exit with ``final=True`` (persistent, so the markers survive this
+        consumer). A closed transport discards them — the sibling sees the
+        dead queue itself.
+
+        The final flush routes through the shared recovery path
+        (:func:`psana_ray_tpu.transport.recovery.return_to_queue`): head
+        placement when supported, timed retries + logged drop otherwise."""
+        if not self._pending_dups:
+            return
+        if final:
+            from psana_ray_tpu.transport.recovery import return_to_queue
+
+            return_to_queue(queue, self._pending_dups, what="sibling EOS marker")
+            self._pending_dups = []
+            return
+        from psana_ray_tpu.transport.registry import TransportClosed
+
+        kept = []
+        for eos in self._pending_dups:
+            try:
+                if not queue.put(eos):
+                    kept.append(eos)
+            except TransportClosed:
+                self._pending_dups = []
+                return
+        self._pending_dups = kept
+
+    @property
+    def complete(self) -> bool:
+        return sum(self._shards_by_rank.values()) >= self._total
 
 
 def decode(buf: bytes):

@@ -51,10 +51,17 @@ DETECTORS = {
 
 @runtime_checkable
 class DataSource(Protocol):
-    """The 3-method surface the producer consumes
-    (reference ``producer.py:81,88,150-154``)."""
+    """The surface the producer consumes (reference ``producer.py:81,88,
+    150-154``), plus indexed iteration so the producer can stamp global
+    event ids without a parallel index stream (the reference counts a local
+    ``idx`` per rank, ``producer.py:88,101``)."""
 
     def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
+        ...
+
+    def iter_indexed_events(
+        self, mode: str = RetrievalMode.CALIB
+    ) -> Iterator[Tuple[int, np.ndarray, float]]:
         ...
 
     def create_bad_pixel_mask(self) -> np.ndarray:

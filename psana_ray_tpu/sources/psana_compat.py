@@ -38,6 +38,15 @@ class PsanaSource:  # pragma: no cover - requires LCLS environment
         return np.asarray(mask, dtype=np.uint8)
 
     def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
+        for _, data, energy in self.iter_indexed_events(mode):
+            yield data, energy
+
+    def iter_indexed_events(
+        self, mode: str = RetrievalMode.CALIB
+    ) -> Iterator[Tuple[int, np.ndarray, float]]:
+        """Yield ``(global_event_idx, data, photon_energy)`` for this shard.
+        Indexing stays aligned when psana yields None for a damaged event —
+        the event number is consumed, the record is skipped."""
         for i, evt in enumerate(self._run.events()):
             if i % self.num_shards != self.shard_rank or i < self.start_event:
                 continue
@@ -50,4 +59,4 @@ class PsanaSource:  # pragma: no cover - requires LCLS environment
             if data is None:
                 continue
             energy = float(self._ebeam.raw.ebeamPhotonEnergy(evt) or 0.0) / 1000.0
-            yield np.asarray(data, dtype=np.float32), energy
+            yield i, np.asarray(data, dtype=np.float32), energy

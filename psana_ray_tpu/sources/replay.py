@@ -63,12 +63,21 @@ class ReplaySource:
             return self._mask.astype(np.uint8)
         return np.ones(self._frames.shape[1:], dtype=np.uint8)
 
-    def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
+    def shard_event_indices(self) -> np.ndarray:
         idxs = shard_indices(self.num_events, self.shard_rank, self.num_shards)
-        for idx in idxs[idxs >= self.start_event]:
+        return idxs[idxs >= self.start_event]
+
+    def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
+        for _, data, energy in self.iter_indexed_events(mode):
+            yield data, energy
+
+    def iter_indexed_events(
+        self, mode: str = RetrievalMode.CALIB
+    ) -> Iterator[Tuple[int, np.ndarray, float]]:
+        """Yield ``(global_event_idx, data, photon_energy)`` for this shard."""
+        for idx in self.shard_event_indices():
             e = float(self._energy[idx]) if self._energy is not None else 9.5
-            yield np.asarray(self._frames[int(idx)]), e
+            yield int(idx), np.asarray(self._frames[int(idx)]), e
 
     def __len__(self) -> int:
-        idxs = shard_indices(self.num_events, self.shard_rank, self.num_shards)
-        return int((idxs >= self.start_event).sum())
+        return len(self.shard_event_indices())

@@ -132,6 +132,14 @@ class SyntheticSource:
         for idx in self.shard_event_indices():
             yield self.event(int(idx), mode)
 
+    def iter_indexed_events(
+        self, mode: str = RetrievalMode.CALIB
+    ) -> Iterator[Tuple[int, np.ndarray, float]]:
+        """Yield ``(global_event_idx, data, photon_energy)`` for this shard."""
+        for idx in self.shard_event_indices():
+            data, energy = self.event(int(idx), mode)
+            yield int(idx), data, energy
+
     def shard_event_indices(self) -> np.ndarray:
         idxs = shard_indices(self.num_events, self.shard_rank, self.num_shards)
         return idxs[idxs >= self.start_event]

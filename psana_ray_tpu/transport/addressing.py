@@ -1,0 +1,108 @@
+"""Address-scheme resolution: one queue-opening surface for every transport.
+
+The reference rendezvouses through Ray's GCS: producers and consumers name
+a queue and namespace, and the cluster resolves it (``shared_queue.py:35``,
+``producer.py:56-67``, ``data_reader.py:20``). Here the address string
+selects the transport and the (namespace, queue_name) pair still names the
+queue within it:
+
+- ``auto`` / ``local`` — in-process :class:`Registry` (tests, single-process
+  pipelines, threads);
+- ``shm://`` or ``shm://<name>`` — cross-process POSIX shared-memory ring on
+  one host. With no explicit ``<name>``, the ring is named from
+  ``<namespace>__<queue_name>`` so the producer CLI and DataReader
+  rendezvous from config alone, exactly like the reference's named actors.
+  The ring is *detached* (parity: ``shared_queue.py:35``): it outlives its
+  creator until destroyed;
+- ``tcp://host:port`` — cross-host queue server (see
+  :mod:`psana_ray_tpu.queue_server`).
+
+Producers open with ``role='producer'`` (get-or-create semantics, parity
+``producer.py:42-48``); consumers with ``role='consumer'`` (resolve with
+retry, parity ``producer.py:56-67``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from psana_ray_tpu.config import TransportConfig
+from psana_ray_tpu.transport.registry import Registry, RendezvousTimeout
+
+
+def shm_ring_name(config: TransportConfig, address: Optional[str] = None) -> str:
+    """The shm object name for a config: explicit ``shm://<name>`` wins,
+    else derived from (namespace, queue_name)."""
+    address = address or config.address
+    explicit = address[len("shm://"):] if address.startswith("shm://") else ""
+    return explicit or f"{config.namespace}__{config.queue_name}"
+
+
+def open_queue(
+    config: TransportConfig,
+    role: str = "consumer",
+    address: Optional[str] = None,
+    registry: Optional[Registry] = None,
+):
+    """Open the queue named by ``config`` over the transport its address
+    selects. Returns an object with the transport contract (put/get/size/
+    put_wait/get_wait/get_batch/close)."""
+    if role not in ("producer", "consumer"):
+        raise ValueError(f"role must be producer|consumer, got {role!r}")
+    address = address or config.address
+
+    if address in ("auto", "local"):
+        reg = registry or Registry.default()
+        from psana_ray_tpu.transport.ring import RingBuffer
+
+        if role == "producer":
+            return reg.get_or_create(
+                config.namespace,
+                config.queue_name,
+                lambda: RingBuffer(config.queue_size, name=config.queue_name),
+            )
+        return reg.resolve(
+            config.namespace,
+            config.queue_name,
+            retries=config.rendezvous_retries,
+            interval_s=config.rendezvous_interval_s,
+        )
+
+    if address.startswith("shm://"):
+        from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
+
+        name = shm_ring_name(config, address)
+        if role == "consumer":
+            return ShmRingBuffer.attach(
+                name,
+                retries=config.rendezvous_retries,
+                interval_s=config.rendezvous_interval_s,
+            )
+        # producer: get-or-create, tolerating the create-vs-attach race the
+        # reference handles with try-get-first (producer.py:42-48). The
+        # native create is O_EXCL, so exactly one creator wins.
+        try:
+            return ShmRingBuffer.attach(name, retries=0, interval_s=0.01)
+        except RendezvousTimeout:
+            pass
+        try:
+            return ShmRingBuffer.create(name, maxsize=config.queue_size)
+        except RuntimeError:
+            # lost the race — another producer created it just now
+            return ShmRingBuffer.attach(
+                name,
+                retries=config.rendezvous_retries,
+                interval_s=config.rendezvous_interval_s,
+            )
+
+    if address.startswith("tcp://"):
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        host, _, port = address[len("tcp://"):].partition(":")
+        if not port:
+            raise ValueError(f"tcp address needs host:port, got {address!r}")
+        return TcpQueueClient(host, int(port))
+
+    raise ValueError(
+        f"unknown address scheme {address!r} (want auto | shm://[name] | tcp://host:port)"
+    )

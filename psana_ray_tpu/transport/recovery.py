@@ -1,0 +1,72 @@
+"""The one audited put-back path for already-popped items.
+
+Three situations return items a process popped (or held) to a shared
+queue: a TCP client dying mid-response (``tcp.TcpQueueServer._requeue``),
+a get-batch straddling the tally-completing EOS (``infeed.batcher``), and
+a consumer exiting while holding sibling EOS markers
+(``records.EosTally.flush_duplicates``). They all route here so recovery
+semantics — head placement when the transport supports it, bounded timed
+retries otherwise, and a logged (never silent) drop — stay consistent.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List, Sequence
+
+from psana_ray_tpu.transport.registry import TransportClosed
+
+logger = logging.getLogger(__name__)
+
+
+def return_to_queue(
+    queue,
+    items: Sequence[Any],
+    *,
+    timeout_s: float = 30.0,
+    what: str = "in-flight item",
+) -> List[Any]:
+    """Return ``items`` (FIFO order preserved) to ``queue``.
+
+    Prefers ``put_front`` — head placement keeps recovered items ahead of
+    any EOS markers behind them (a tally-driven consumer would otherwise
+    stop before reading them), and is allowed past maxsize so it cannot
+    fail. Transports without it get tail appends with timed retries up to
+    ``timeout_s`` total.
+
+    Returns the items that could NOT be returned (always logged, never a
+    silent drop); empty on success or when the queue is closed (a dead
+    transport has no sibling left to starve).
+    """
+    items = list(items)
+    if not items:
+        return []
+    put_front = getattr(queue, "put_front", None)
+    if put_front is not None:
+        # appendleft in reverse so items[0] ends up at the head
+        for item in reversed(items):
+            try:
+                put_front(item)
+            except TransportClosed:
+                return []
+        return []
+    deadline = time.monotonic() + timeout_s
+    for i, item in enumerate(items):
+        returned = False
+        while time.monotonic() < deadline:
+            wait = min(5.0, max(0.1, deadline - time.monotonic()))
+            try:
+                if queue.put_wait(item, timeout=wait):
+                    returned = True
+                    break
+            except TransportClosed:
+                return []
+        if not returned:
+            rest = items[i:]
+            logger.warning(
+                "dropping %d %s(s): queue stayed full for %.0f s",
+                len(rest), what, timeout_s,
+            )
+            return rest
+    return []

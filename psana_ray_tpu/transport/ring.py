@@ -93,6 +93,18 @@ class RingBuffer:
         with self._lock:
             return len(self._q)
 
+    def put_front(self, item: Any) -> bool:
+        """Return an item to the HEAD of the queue (recovery path: an item
+        popped but never delivered must come back *before* anything behind
+        it — especially EOS markers, or a tally-driven consumer stops
+        without ever seeing it). Exceeding maxsize by the returned item is
+        allowed: it was counted when first enqueued."""
+        with self._lock:
+            self._check_open()
+            self._q.appendleft(item)
+            self._not_empty.notify()
+            return True
+
     # -- blocking variants (new capability) ------------------------------
     def put_wait(self, item: Any, timeout: Optional[float] = None) -> bool:
         """Block until space is available (or timeout). Returns success."""

@@ -40,28 +40,68 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _lib_is_stale() -> bool:
+    """True when the .so is missing or older than any native source —
+    a stale binary must never shadow an edited shmring.cpp."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for fname in os.listdir(_NATIVE_DIR):
+        if fname.endswith((".cpp", ".h", ".hpp")) or fname == "Makefile":
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, fname)) > lib_mtime:
+                return True
+    return False
+
+
 def _load_lib() -> ctypes.CDLL:
-    """Load (building if needed) the native library. Raises RuntimeError
-    with guidance when no toolchain is available."""
+    """Load (building/rebuilding if needed) the native library. Raises
+    RuntimeError with guidance when no toolchain is available or the
+    binary does not load on this platform.
+
+    Build + load run under an inter-process file lock: the runbook starts
+    producer and consumers near-simultaneously, and without the lock each
+    process would race its own ``make`` while another dlopens the
+    half-written .so."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        import fcntl
+
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
             try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR, "-s"],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired) as e:
-                detail = getattr(e, "stderr", b"")
-                raise RuntimeError(
-                    "could not build native shm ring (needs g++/make); use the "
-                    f"in-process RingBuffer or TCP transport instead: {detail!r}"
-                ) from e
-        lib = ctypes.CDLL(_LIB_PATH)
+                if _lib_is_stale():  # re-check under the lock: a sibling
+                    try:             # process may have just built it
+                        subprocess.run(
+                            ["make", "-C", _NATIVE_DIR, "-s", "-B"],
+                            check=True,
+                            capture_output=True,
+                            timeout=120,
+                        )
+                    except (
+                        subprocess.CalledProcessError,
+                        FileNotFoundError,
+                        subprocess.TimeoutExpired,
+                    ) as e:
+                        detail = getattr(e, "stderr", b"")
+                        if not os.path.exists(_LIB_PATH):
+                            raise RuntimeError(
+                                "could not build native shm ring (needs g++/make); "
+                                "use the in-process RingBuffer or TCP transport "
+                                f"instead: {detail!r}"
+                            ) from e
+                        # stale-but-present binary + no toolchain: load as-is
+                try:
+                    lib = ctypes.CDLL(_LIB_PATH)
+                except OSError as e:  # wrong arch/glibc for a prebuilt binary
+                    raise RuntimeError(
+                        f"native shm ring library failed to load on this platform "
+                        f"({e}); use the in-process RingBuffer or TCP transport instead"
+                    ) from e
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
         lib.shmring_create.restype = ctypes.c_void_p
         lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
         lib.shmring_attach.restype = ctypes.c_void_p

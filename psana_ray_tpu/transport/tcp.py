@@ -11,14 +11,28 @@ object-store copy.
 
 Wire protocol (all little-endian):
     request:  op:u8 ('P'|'G'|'S'|'C') + [P only] len:u32 + payload
+              'B' (get-batch) + max_items:u32
+              'Q' (put-batch) + count:u32 + count x (len:u32 + payload)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
               + [G ok] len:u32 + payload   + [S] size:u32
+              + [B ok] count:u32 + count x (len:u32 + payload)
+              + [Q ok] accepted:u32
+
+The batch opcodes exist so a cross-host consumer drains N records per
+round trip instead of reintroducing the reference's one-RPC-per-event
+bottleneck (reference ``data_reader.py:35``, SURVEY.md §3.1) over the
+network hop.
 
 Payloads reuse the shm codec (records wire format / tagged pickle).
+
+In-flight items are never dropped on a consumer crash: if the connection
+dies between the queue pop and the response write, the server re-enqueues
+the popped item(s).
 """
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import struct
 import threading
@@ -32,6 +46,8 @@ _OP_PUT = b"P"
 _OP_GET = b"G"
 _OP_SIZE = b"S"
 _OP_CLOSE = b"C"
+_OP_GET_BATCH = b"B"
+_OP_PUT_BATCH = b"Q"
 _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
@@ -79,12 +95,27 @@ class TcpQueueServer:
                 continue
             except OSError:
                 return
+            # prune finished connection threads — the server is a
+            # long-lived service (queue_server.py) and must not grow
+            # unboundedly across client reconnects
+            self._threads = [t for t in self._threads if t.is_alive()]
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
 
+    def _requeue(self, items):
+        """Put back items popped but never delivered (the client connection
+        died mid-response) via the shared recovery path: queue HEAD so they
+        precede any EOS markers already enqueued (a tally-driven consumer
+        would otherwise stop without reading them), timed tail retries with
+        a logged drop for backings without ``put_front`` (shm ring)."""
+        from psana_ray_tpu.transport.recovery import return_to_queue
+
+        return_to_queue(self.queue, items, what="in-flight frame")
+
     def _serve_conn(self, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        in_flight: List[Any] = []  # popped items whose response is pending
         try:
             while not self._stop.is_set():
                 op = _recv_exact(conn, 1)
@@ -99,8 +130,36 @@ class TcpQueueServer:
                         if item is EMPTY:
                             conn.sendall(_ST_NO)
                         else:
+                            in_flight = [item]
                             payload = _encode(item)
                             conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
+                            in_flight = []
+                    elif op == _OP_GET_BATCH:
+                        (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
+                        items = self.queue.get_batch(min(max_items, 4096), timeout=0.0)
+                        in_flight = list(items)
+                        parts = [_ST_OK, struct.pack("<I", len(items))]
+                        for item in items:
+                            payload = _encode(item)
+                            parts.append(struct.pack("<I", len(payload)))
+                            parts.append(payload)
+                        conn.sendall(b"".join(parts))
+                        in_flight = []
+                    elif op == _OP_PUT_BATCH:
+                        # read the WHOLE request before touching the queue:
+                        # an error mid-put (closed transport) must not leave
+                        # half the request unread and desync the stream
+                        (count,) = struct.unpack("<I", _recv_exact(conn, 4))
+                        payloads = []
+                        for _ in range(count):
+                            (n,) = struct.unpack("<I", _recv_exact(conn, 4))
+                            payloads.append(_recv_exact(conn, n))
+                        accepted = 0
+                        for payload in payloads:
+                            if not self.queue.put(_decode(payload)):
+                                break  # full: accepted prefix only (FIFO)
+                            accepted += 1
+                        conn.sendall(_ST_OK + struct.pack("<I", accepted))
                     elif op == _OP_SIZE:
                         conn.sendall(_ST_OK + struct.pack("<I", self.queue.size()))
                     elif op == _OP_CLOSE:
@@ -112,7 +171,7 @@ class TcpQueueServer:
                 except TransportClosed:
                     conn.sendall(_ST_CLOSED)
         except (ConnectionError, OSError):
-            pass
+            self._requeue(in_flight)
         finally:
             conn.close()
 
@@ -125,7 +184,13 @@ class TcpQueueServer:
 
 
 class TcpQueueClient:
-    """Client with the transport contract (put/get/size/get_wait/...)."""
+    """Client with the transport contract (put/get/size/get_wait/...).
+
+    A dead server (killed process, dropped connection) surfaces as
+    :class:`TransportClosed` from every contract method — the same signal a
+    gracefully closed queue sends — so consumers' dead-transport handling
+    (``DataReaderError``, batcher tail-flush) works for both (parity role:
+    ``RayActorError``, reference ``data_reader.py:36-37``)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 30.0):
         self.host, self.port = host, port
@@ -133,15 +198,25 @@ class TcpQueueClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
+    @contextlib.contextmanager
+    def _io(self):
+        """Map raw socket failures to TransportClosed."""
+        try:
+            yield
+        except (ConnectionError, socket.timeout, OSError) as e:
+            raise TransportClosed(
+                f"connection to queue server {self.host}:{self.port} died: {e}"
+            ) from e
+
     # -- contract ---------------------------------------------------------
     def put(self, item: Any) -> bool:
         payload = _encode(item)
-        with self._lock:
+        with self._lock, self._io():
             self._sock.sendall(_OP_PUT + struct.pack("<I", len(payload)) + payload)
             return self._status() == _ST_OK
 
     def get(self) -> Any:
-        with self._lock:
+        with self._lock, self._io():
             self._sock.sendall(_OP_GET)
             st = self._status()
             if st == _ST_NO:
@@ -150,7 +225,7 @@ class TcpQueueClient:
             return _decode(_recv_exact(self._sock, n))
 
     def size(self) -> int:
-        with self._lock:
+        with self._lock, self._io():
             self._sock.sendall(_OP_SIZE)
             st = self._status()
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
@@ -158,7 +233,7 @@ class TcpQueueClient:
 
     def close_remote(self):
         """Close the remote queue (fault-injection / teardown)."""
-        with self._lock:
+        with self._lock, self._io():
             self._sock.sendall(_OP_CLOSE)
             self._status()
 
@@ -187,17 +262,43 @@ class TcpQueueClient:
             time.sleep(poll_s)
 
     def get_batch(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
-        out = []
-        first = self.get_wait(timeout=timeout)
-        if first is EMPTY:
+        """Drain up to ``max_items`` in ONE round trip (opcode 'B'); polls
+        until ``timeout`` when the remote queue is momentarily empty."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            out = self._get_batch_once(max_items)
+            if out:
+                return out
+            if deadline is not None and time.monotonic() >= deadline:
+                return []
+            time.sleep(0.001)
+
+    def _get_batch_once(self, max_items: int) -> List[Any]:
+        with self._lock, self._io():
+            self._sock.sendall(_OP_GET_BATCH + struct.pack("<I", max_items))
+            self._status()
+            (count,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            out = []
+            for _ in range(count):
+                (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+                out.append(_decode(_recv_exact(self._sock, n)))
             return out
-        out.append(first)
-        while len(out) < max_items:
-            item = self.get()
-            if item is EMPTY:
-                break
-            out.append(item)
-        return out
+
+    def put_batch(self, items: List[Any]) -> int:
+        """Send N items in ONE round trip (opcode 'Q'); returns how many
+        the server accepted (a full queue truncates — retry the rest)."""
+        payloads = [_encode(i) for i in items]
+        parts = [_OP_PUT_BATCH, struct.pack("<I", len(payloads))]
+        for p in payloads:
+            parts.append(struct.pack("<I", len(p)))
+            parts.append(p)
+        with self._lock, self._io():
+            self._sock.sendall(b"".join(parts))
+            self._status()
+            (accepted,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return accepted
 
     def disconnect(self):
         try:

@@ -6,7 +6,7 @@ payload-schema drift."""
 import numpy as np
 import pytest
 
-from psana_ray_tpu.records import EndOfStream, FrameRecord, decode, is_eos
+from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord, decode, is_eos
 
 
 def test_frame_record_fields():
@@ -61,3 +61,44 @@ def test_eos_wire_roundtrip():
 def test_decode_rejects_garbage():
     with pytest.raises(ValueError):
         decode(b"\x00\x00\x00\x00garbage....")
+
+
+class TestEosAggregation:
+    """Multi-producer EOS: markers carry shard coverage; EosTally stops
+    consumers only when every global shard is accounted for (the role the
+    reference's global MPI barrier played, producer.py:119-126)."""
+
+    def test_v2_wire_roundtrip_with_coverage(self):
+        eos = EndOfStream(producer_rank=3, total_events=64, shards_done=2, total_shards=6)
+        out = decode(eos.to_bytes())
+        assert out.shards_done == 2
+        assert out.total_shards == 6
+        assert out.producer_rank == 3
+
+    def test_v1_wire_decodes_with_default_coverage(self):
+        import struct
+
+        from psana_ray_tpu.records import _EOS_HEADER_V1, _EOS_MAGIC
+
+        buf = _EOS_HEADER_V1.pack(_EOS_MAGIC, 1, 5, 100)  # schema v1, no coverage
+        out = EndOfStream.from_bytes(buf)
+        assert out.producer_rank == 5
+        assert out.shards_done == 1 and out.total_shards == 1
+
+    def test_tally_single_producer(self):
+        t = EosTally()
+        assert t.observe(EndOfStream())  # 1/1 shard -> complete
+
+    def test_tally_waits_for_all_runtimes(self):
+        t = EosTally()
+        assert not t.observe(EndOfStream(producer_rank=0, shards_done=2, total_shards=4))
+        assert not t.complete
+        assert t.observe(EndOfStream(producer_rank=2, shards_done=2, total_shards=4))
+
+    def test_tally_flags_duplicates(self):
+        t = EosTally()
+        eos = EndOfStream(producer_rank=0, shards_done=1, total_shards=2)
+        assert not t.is_duplicate(eos)
+        t.observe(eos)
+        assert t.is_duplicate(eos)
+        assert not t.is_duplicate(EndOfStream(producer_rank=1, shards_done=1, total_shards=2))

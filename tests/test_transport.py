@@ -183,3 +183,13 @@ class TestBackoff:
         assert p.retries == 2
         p.reset()
         assert p.retries == 0
+
+
+def test_put_front_returns_item_to_head():
+    from psana_ray_tpu.transport import RingBuffer
+
+    q = RingBuffer(maxsize=2)
+    q.put(1)
+    q.put(2)  # full
+    assert q.put_front(0)  # recovery path may exceed maxsize
+    assert [q.get() for _ in range(3)] == [0, 1, 2]

@@ -1,0 +1,83 @@
+"""Standalone queue server — the ``ray start --head`` of this framework.
+
+The reference's runbook starts a Ray head node whose GCS hosts the detached
+queue actor (``README.md:13-18``, ``shared_queue.py:35``); producers and
+consumers on other nodes join it by address. Here the equivalent service is
+one process serving a bounded queue over TCP (:mod:`transport.tcp`), which
+remote producers/consumers reach with ``--address tcp://host:port``.
+
+Optionally backed by a shared-memory ring (``--shm``) so local processes on
+the serving host can bypass TCP entirely while remote ones fan in/out over
+the network.
+
+Teardown parity (``ray stop``, reference ``README.md:37-40``): SIGINT/SIGTERM
+closes the queue, unblocking all clients with a dead-transport error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="psana-ray-tpu-queue")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=6379, help="reference head-node port")
+    p.add_argument("--queue_size", type=int, default=100)
+    p.add_argument(
+        "--shm",
+        default=None,
+        metavar="NAME",
+        help="back the server with shm ring NAME (local procs attach via shm://NAME)",
+    )
+    p.add_argument("--log_level", default="INFO")
+    a = p.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, a.log_level.upper(), logging.INFO),
+        format="%(asctime)s - %(levelname)s - %(message)s",
+    )
+
+    from psana_ray_tpu.transport.ring import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueServer
+
+    if a.shm:
+        from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
+
+        try:
+            backing = ShmRingBuffer.create(a.shm, maxsize=a.queue_size)
+        except RuntimeError:
+            backing = ShmRingBuffer.attach(a.shm, retries=1, interval_s=0.1)
+        logger.info("backing queue: shm ring %r", a.shm)
+    else:
+        backing = RingBuffer(a.queue_size)
+
+    server = TcpQueueServer(backing, host=a.host, port=a.port).serve_background()
+    logger.info(
+        "queue server listening on %s:%d (size=%d) — clients use --address tcp://<host>:%d",
+        a.host, server.port, a.queue_size, server.port,
+    )
+
+    done = threading.Event()
+
+    def _stop(sig, frame):
+        logger.info("signal %s — shutting down queue server", sig)
+        done.set()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    done.wait()
+    try:
+        backing.close()  # unblock clients with TransportClosed (dead-queue parity)
+    except Exception:
+        pass
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

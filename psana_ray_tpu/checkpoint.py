@@ -24,17 +24,49 @@ import jax
 
 @dataclasses.dataclass
 class StreamCursor:
-    """Highest contiguous event_idx processed, per shard rank."""
+    """True contiguous watermark of processed ``event_idx``, per shard.
 
+    Batched/pipelined consumers complete events out of order; a naive
+    high-water mark would then resume past never-processed events below it,
+    silently skipping data. Here ``advance`` holds out-of-order completions
+    in a pending set and only moves the watermark when every lower index of
+    the shard's strided sequence (shard ``r`` owns ``r, r+stride, ...``,
+    matching ``sources.base.shard_indices``) has been seen.
+
+    Semantics are at-least-once: pending indices ahead of the watermark are
+    not persisted, so a crash-resume re-processes them. Downstream sinks
+    must tolerate duplicates (or dedupe on the ``(shard_rank, event_idx)``
+    stamp every record carries — the provenance hook the reference has but
+    never uses, ``producer.py:101``).
+    """
+
+    stride: int = 1
     positions: Dict[int, int] = dataclasses.field(default_factory=dict)
+    _pending: Dict[int, set] = dataclasses.field(default_factory=dict)
 
     def advance(self, shard_rank: int, event_idx: int):
-        cur = self.positions.get(int(shard_rank), -1)
-        self.positions[int(shard_rank)] = max(cur, int(event_idx))
+        r, idx = int(shard_rank), int(event_idx)
+        pend = self._pending.setdefault(r, set())
+        pend.add(idx)
+        cur = self.positions.get(r)
+        nxt = (r % self.stride) if cur is None else cur + self.stride
+        while nxt in pend:
+            pend.discard(nxt)
+            self.positions[r] = nxt
+            nxt += self.stride
 
     def resume_point(self, shard_rank: int) -> int:
-        """First event this shard should (re)process."""
-        return self.positions.get(int(shard_rank), -1) + 1
+        """First event this shard should (re)process: everything at or
+        below the watermark is durably done; anything pending above it
+        will be re-done (at-least-once)."""
+        r = int(shard_rank)
+        cur = self.positions.get(r)
+        return (r % self.stride) if cur is None else cur + self.stride
+
+    def pending_count(self, shard_rank: int) -> int:
+        """Out-of-order completions held above the watermark (these would
+        re-run after a crash at this point)."""
+        return len(self._pending.get(int(shard_rank), ()))
 
     # -- persistence (atomic JSON; tiny, human-readable) ------------------
     def save(self, path: str):
@@ -43,7 +75,13 @@ class StreamCursor:
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".cursor")
         try:
             with os.fdopen(fd, "w") as f:
-                json.dump({str(k): v for k, v in self.positions.items()}, f)
+                json.dump(
+                    {
+                        "stride": self.stride,
+                        "positions": {str(k): v for k, v in self.positions.items()},
+                    },
+                    f,
+                )
             os.replace(tmp, path)  # atomic — a crash never corrupts the cursor
         except BaseException:
             if os.path.exists(tmp):
@@ -56,7 +94,14 @@ class StreamCursor:
             return StreamCursor()
         with open(path) as f:
             raw = json.load(f)
-        return StreamCursor({int(k): int(v) for k, v in raw.items()})
+        if "positions" not in raw:  # pre-watermark format: {rank: idx}
+            return StreamCursor(
+                stride=1, positions={int(k): int(v) for k, v in raw.items()}
+            )
+        return StreamCursor(
+            stride=int(raw.get("stride", 1)),
+            positions={int(k): int(v) for k, v in raw["positions"].items()},
+        )
 
 
 def save_train_state(path: str, state) -> None:

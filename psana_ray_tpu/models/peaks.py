@@ -1,0 +1,202 @@
+"""Bragg-peak extraction from segmentation logits + CXI output writer.
+
+Closes the loop the reference's own packaging names as its mission —
+"Save PeakNet inference results to CXI" (reference ``setup.py:11``; SFX
+keyword at ``setup.py:15``) — but which exists nowhere in its code.
+
+Pipeline: PeakNet U-Net logits ``[N, H, W, 1]`` -> :func:`find_peaks`
+(device-side, jittable: sigmoid threshold + 3x3 local-maximum test +
+top-K by score, fixed shapes so pjit never recompiles) -> host-side
+:class:`CxiWriter` appending the peak lists per event in the CXI layout
+(``/entry_1/result_1/peakXPosRaw`` et al.) that downstream SFX indexing
+tools (CrystFEL and friends) consume.
+
+TPU notes: the peak test is pure elementwise + a 3x3 max reduce — XLA
+fuses it; ``top_k`` gives a FIXED peak-count output (padded, with a
+validity count) so a streaming consumer never sees a shape change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def find_peaks(
+    logits: jax.Array,
+    max_peaks: int = 128,
+    threshold: float = 0.5,
+    min_distance: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Extract up to ``max_peaks`` peak centers from ``[N, H, W, 1]`` (or
+    ``[N, H, W]``) segmentation logits.
+
+    A pixel is a peak when its probability exceeds ``threshold`` AND it is
+    the maximum of its ``(2*min_distance+1)^2`` neighborhood (ties broken
+    toward the first in raster order, matching the classic local-max rule).
+
+    Returns ``(yx, score, n)``: ``yx [N, max_peaks, 2]`` int32 row/col
+    (padded entries are (-1,-1)), ``score [N, max_peaks]`` f32 probability
+    (padded 0), ``n [N]`` int32 valid count. Fixed shapes — jit/pjit safe.
+    """
+    if logits.ndim == 4:
+        logits = logits[..., 0]
+    n_, h, w = logits.shape
+    prob = jax.nn.sigmoid(logits.astype(jnp.float32))
+    k = 2 * min_distance + 1
+    neigh = jax.lax.reduce_window(
+        prob, -jnp.inf, jax.lax.max, (1, k, k), (1, 1, 1), "SAME"
+    )
+    # strict local max with raster-order tie-break: equal-max neighbors
+    # earlier in raster order suppress later ones
+    rank = (
+        jnp.arange(h * w, dtype=jnp.float32).reshape(1, h, w) * 1e-9
+    )
+    keyed = prob - rank
+    neigh_keyed = jax.lax.reduce_window(
+        keyed, -jnp.inf, jax.lax.max, (1, k, k), (1, 1, 1), "SAME"
+    )
+    is_peak = (prob >= threshold) & (keyed >= neigh_keyed)
+
+    flat_score = jnp.where(is_peak, prob, 0.0).reshape(n_, h * w)
+    score, idx = jax.lax.top_k(flat_score, max_peaks)
+    valid = score > 0.0
+    yy = jnp.where(valid, idx // w, -1).astype(jnp.int32)
+    xx = jnp.where(valid, idx % w, -1).astype(jnp.int32)
+    yx = jnp.stack([yy, xx], axis=-1)
+    return yx, jnp.where(valid, score, 0.0), valid.sum(axis=1).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class PeakSet:
+    """Host-side peak list for one event (unpadded)."""
+
+    event_idx: int
+    shard_rank: int
+    y: np.ndarray  # [n] float32 row position
+    x: np.ndarray  # [n] float32 col position
+    intensity: np.ndarray  # [n] float32
+    photon_energy: float = 0.0
+
+    @property
+    def n(self) -> int:
+        return len(self.y)
+
+
+def unpad_peaks(yx, score, n, event_idx=None, shard_rank=None, photon_energy=None):
+    """Device outputs of :func:`find_peaks` -> list of host PeakSets."""
+    yx = np.asarray(yx)
+    score = np.asarray(score)
+    n = np.asarray(n)
+    out = []
+    for i in range(len(n)):
+        k = int(n[i])
+        out.append(
+            PeakSet(
+                event_idx=int(event_idx[i]) if event_idx is not None else i,
+                shard_rank=int(shard_rank[i]) if shard_rank is not None else 0,
+                y=yx[i, :k, 0].astype(np.float32),
+                x=yx[i, :k, 1].astype(np.float32),
+                intensity=score[i, :k].astype(np.float32),
+                photon_energy=float(photon_energy[i]) if photon_energy is not None else 0.0,
+            )
+        )
+    return out
+
+
+class CxiWriter:
+    """Append peak lists to a CXI (HDF5) file in the peakfinder layout.
+
+    Datasets (under ``/entry_1/result_1``): ``nPeaks [N]``,
+    ``peakXPosRaw / peakYPosRaw / peakTotalIntensity [N, max_peaks]`` —
+    the layout CrystFEL's CXI interface and psocake write/read. Event
+    provenance (``shard_rank``/``event_idx``) and photon energy
+    (``/LCLS/photon_energy_eV``) ride along. Resizable, chunked, flushed
+    per batch: a crash loses at most the unflushed tail.
+    """
+
+    def __init__(self, path: str, max_peaks: int = 128):
+        import h5py
+
+        self.path = path
+        self.max_peaks = max_peaks
+        self._f = h5py.File(path, "w")
+        g = self._f.create_group("entry_1").create_group("result_1")
+        mk = lambda name, shape, dtype: g.create_dataset(  # noqa: E731
+            name, shape=(0, *shape), maxshape=(None, *shape), dtype=dtype,
+            chunks=(256, *shape),
+        )
+        self._n = mk("nPeaks", (), np.int32)
+        self._x = mk("peakXPosRaw", (max_peaks,), np.float32)
+        self._y = mk("peakYPosRaw", (max_peaks,), np.float32)
+        self._i = mk("peakTotalIntensity", (max_peaks,), np.float32)
+        lcls = self._f.create_group("LCLS")
+        self._energy = lcls.create_dataset(
+            "photon_energy_eV", shape=(0,), maxshape=(None,), dtype=np.float64,
+            chunks=(256,),
+        )
+        self._rank = lcls.create_dataset(
+            "shard_rank", shape=(0,), maxshape=(None,), dtype=np.int32, chunks=(256,)
+        )
+        self._event = lcls.create_dataset(
+            "event_idx", shape=(0,), maxshape=(None,), dtype=np.int64, chunks=(256,)
+        )
+        self._count = 0
+
+    def append(self, peaks: Sequence[PeakSet]):
+        if not peaks:
+            return
+        m = self.max_peaks
+        start, end = self._count, self._count + len(peaks)
+        for d in (self._n, self._x, self._y, self._i, self._energy, self._rank, self._event):
+            d.resize(end, axis=0)
+        for j, p in enumerate(peaks):
+            k = min(p.n, m)
+            row_x = np.zeros(m, np.float32)
+            row_y = np.zeros(m, np.float32)
+            row_i = np.zeros(m, np.float32)
+            row_x[:k] = p.x[:k]
+            row_y[:k] = p.y[:k]
+            row_i[:k] = p.intensity[:k]
+            i = start + j
+            self._n[i] = k
+            self._x[i] = row_x
+            self._y[i] = row_y
+            self._i[i] = row_i
+            self._energy[i] = p.photon_energy * 1000.0  # keV -> eV
+            self._rank[i] = p.shard_rank
+            self._event[i] = p.event_idx
+        self._count = end
+        self._f.flush()
+
+    @property
+    def n_events(self) -> int:
+        return self._count
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_cxi_peaks(path: str):
+    """Read back (nPeaks, x, y, intensity, event_idx) from a CXI file."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        g = f["entry_1/result_1"]
+        return (
+            g["nPeaks"][:],
+            g["peakXPosRaw"][:],
+            g["peakYPosRaw"][:],
+            g["peakTotalIntensity"][:],
+            f["LCLS/event_idx"][:],
+        )

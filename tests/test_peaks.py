@@ -1,0 +1,93 @@
+"""Peak extraction + CXI writer: synthetic frames with known peak positions
+round-trip through find_peaks -> CXI (VERDICT r1 next-round item #10; the
+reference names this mission in its packaging, setup.py:11, but ships none
+of it)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psana_ray_tpu.models.peaks import (
+    CxiWriter,
+    find_peaks,
+    read_cxi_peaks,
+    unpad_peaks,
+)
+
+
+def _logits_with_peaks(h, w, centers, hot=8.0, cold=-8.0):
+    """Logit map: `cold` everywhere, `hot` bumps at the given centers with
+    a slightly dimmer ring so the local-max rule is actually exercised."""
+    z = np.full((h, w), cold, np.float32)
+    for (cy, cx) in centers:
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                z[cy + dy, cx + dx] = hot - 2.0 * (abs(dy) + abs(dx))
+    return z
+
+
+class TestFindPeaks:
+    def test_recovers_known_positions(self):
+        centers = [(5, 7), (20, 33), (40, 12)]
+        z = _logits_with_peaks(48, 48, centers)
+        yx, score, n = jax.jit(find_peaks, static_argnums=(1,))(z[None], 16)
+        assert int(n[0]) == 3
+        got = {tuple(map(int, p)) for p in np.asarray(yx[0][: int(n[0])])}
+        assert got == set(centers)
+        assert np.all(np.asarray(score[0][:3]) > 0.9)
+
+    def test_padded_fixed_shapes(self):
+        z = _logits_with_peaks(32, 32, [(10, 10)])
+        yx, score, n = find_peaks(z[None], max_peaks=8)
+        assert yx.shape == (1, 8, 2) and score.shape == (1, 8)
+        assert int(n[0]) == 1
+        assert np.all(np.asarray(yx[0][1:]) == -1)  # padding marked
+
+    def test_threshold_suppresses_background(self):
+        z = np.zeros((1, 16, 16), np.float32)  # sigmoid=0.5 everywhere
+        _, _, n = find_peaks(z, max_peaks=8, threshold=0.6)
+        assert int(n[0]) == 0
+
+    def test_plateau_yields_single_peak(self):
+        z = np.full((1, 16, 16), -8.0, np.float32)
+        z[0, 4:6, 4:6] = 6.0  # 2x2 plateau — tie-broken to ONE peak
+        _, _, n = find_peaks(z, max_peaks=8)
+        assert int(n[0]) == 1
+
+
+class TestCxiRoundtrip:
+    def test_roundtrip(self, tmp_path):
+        centers = [(5, 7), (20, 33)]
+        z = jnp.asarray(_logits_with_peaks(48, 48, centers)[None])
+        yx, score, n = find_peaks(z, max_peaks=16)
+        peaks = unpad_peaks(
+            yx, score, n,
+            event_idx=np.array([42]), shard_rank=np.array([3]),
+            photon_energy=np.array([9.5]),
+        )
+        path = str(tmp_path / "peaks.cxi")
+        with CxiWriter(path, max_peaks=16) as wtr:
+            wtr.append(peaks)
+            assert wtr.n_events == 1
+        n_back, x, y, inten, ev = read_cxi_peaks(path)
+        assert n_back[0] == 2
+        got = {(int(yy), int(xx)) for yy, xx in zip(y[0][:2], x[0][:2])}
+        assert got == set(centers)
+        assert ev[0] == 42
+        assert np.all(inten[0][:2] > 0.9)
+
+    def test_append_batches(self, tmp_path):
+        path = str(tmp_path / "multi.cxi")
+        z = jnp.asarray(
+            np.stack([_logits_with_peaks(32, 32, [(8, 8)]),
+                      _logits_with_peaks(32, 32, [(4, 4), (20, 20)])])
+        )
+        yx, score, n = find_peaks(z, max_peaks=8)
+        with CxiWriter(path, max_peaks=8) as wtr:
+            wtr.append(unpad_peaks(yx, score, n))
+            wtr.append(unpad_peaks(yx, score, n))
+            assert wtr.n_events == 4
+        n_back, *_ = read_cxi_peaks(path)
+        assert list(n_back) == [1, 2, 1, 2]

@@ -47,20 +47,24 @@ def find_peaks(
         logits = logits[..., 0]
     n_, h, w = logits.shape
     prob = jax.nn.sigmoid(logits.astype(jnp.float32))
-    k = 2 * min_distance + 1
-    neigh = jax.lax.reduce_window(
-        prob, -jnp.inf, jax.lax.max, (1, k, k), (1, 1, 1), "SAME"
-    )
-    # strict local max with raster-order tie-break: equal-max neighbors
-    # earlier in raster order suppress later ones
-    rank = (
-        jnp.arange(h * w, dtype=jnp.float32).reshape(1, h, w) * 1e-9
-    )
-    keyed = prob - rank
-    neigh_keyed = jax.lax.reduce_window(
-        keyed, -jnp.inf, jax.lax.max, (1, k, k), (1, 1, 1), "SAME"
-    )
-    is_peak = (prob >= threshold) & (keyed >= neigh_keyed)
+    # Local-max test with exact raster-order tie-break: a pixel survives
+    # unless some window neighbor beats it on (prob, earlier raster index).
+    # Unrolled shifted comparisons (static (2d+1)^2-1 slices, XLA fuses the
+    # whole stack into one elementwise kernel) — exact where a float
+    # "prob - idx*eps" key would lose the tie-break to f32 rounding near 1.
+    d = min_distance
+    idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, h, w)
+    pprob = jnp.pad(prob, ((0, 0), (d, d), (d, d)), constant_values=-jnp.inf)
+    pidx = jnp.pad(idx, ((0, 0), (d, d), (d, d)), constant_values=h * w)
+    beaten = jnp.zeros(prob.shape, dtype=bool)
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            if dy == 0 and dx == 0:
+                continue
+            sp = pprob[:, d + dy : d + dy + h, d + dx : d + dx + w]
+            si = pidx[:, d + dy : d + dy + h, d + dx : d + dx + w]
+            beaten |= (sp > prob) | ((sp == prob) & (si < idx))
+    is_peak = (prob >= threshold) & ~beaten
 
     flat_score = jnp.where(is_peak, prob, 0.0).reshape(n_, h * w)
     score, idx = jax.lax.top_k(flat_score, max_peaks)

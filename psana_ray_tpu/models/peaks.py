@@ -11,8 +11,9 @@ top-K by score, fixed shapes so pjit never recompiles) -> host-side
 (``/entry_1/result_1/peakXPosRaw`` et al.) that downstream SFX indexing
 tools (CrystFEL and friends) consume.
 
-TPU notes: the peak test is pure elementwise + a 3x3 max reduce — XLA
-fuses it; ``top_k`` gives a FIXED peak-count output (padded, with a
+TPU notes: the peak test is pad + unrolled shifted comparisons (integer-
+exact tie-breaks), all elementwise — XLA fuses the unrolled window into
+one kernel; ``top_k`` gives a FIXED peak-count output (padded, with a
 validity count) so a streaming consumer never sees a shape change.
 """
 

@@ -112,6 +112,7 @@ def batches_from_queue(
     batch_size: int,
     poll_interval_s: float = 0.01,
     max_wait_s: Optional[float] = None,
+    stop=None,
 ) -> Iterator[Batch]:
     """Drain a transport queue into fixed-shape batches until EOS.
 
@@ -119,7 +120,10 @@ def batches_from_queue(
     reference's one-RPC-per-event read (``data_reader.py:35``). On stream
     completion the tail is flushed padded; iteration then stops.
     ``max_wait_s`` bounds total starvation (None = wait forever, matching
-    the reference consumer loop).
+    the reference consumer loop). ``stop`` (a ``threading.Event``) makes
+    the generator cancellable from another thread — a starved poll loop
+    would otherwise be uninterruptible (pending frames are NOT flushed on
+    a stop: cancellation abandons the stream).
 
     Multiple producer runtimes may feed one queue, each emitting its own
     EOS (no global MPI barrier here, unlike reference ``producer.py:
@@ -132,6 +136,8 @@ def batches_from_queue(
     tally = EosTally()
     try:
         while True:
+            if stop is not None and stop.is_set():
+                return
             try:
                 items = queue.get_batch(batch_size, timeout=poll_interval_s)
             except TransportClosed:

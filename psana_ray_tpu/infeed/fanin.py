@@ -1,0 +1,211 @@
+"""Multi-detector fan-in: N detector streams -> one consumer loop.
+
+BASELINE config 5 ("multi-detector (epix10k2M + Jungfrau4M) kHz-rate
+multi-run fan-in"). The reference has no fan-in component at all — one
+queue, one frame shape, one consumer loop; running two detectors means
+two disjoint deployments.
+
+Design (TPU-first):
+
+- **One InfeedPipeline per detector.** pjit compiles one program per
+  input shape; mixing detectors in one batch would force recompiles or
+  padding to the max geometry (a jungfrau4M frame is 4.2 MB, an
+  epix10k2M frame 8.6 MB — padding wastes ~50% of HBM bandwidth).
+  Fixed per-detector shapes mean each detector's step compiles exactly
+  once and the MXU tiling stays exact.
+- **Ready-ordered merge.** Each leg runs transport -> batcher -> device
+  prefetch (the existing :class:`InfeedPipeline` wiring) on its own
+  thread and deposits device-resident batches into one bounded merge
+  queue; the consumer loop takes batches in arrival order, so a kHz
+  jungfrau never waits behind a 120 Hz epix (no head-of-line blocking,
+  no round-robin starvation).
+- **Per-detector steps.** ``run`` dispatches each batch to its
+  detector's compiled step; dispatch is async, so the device pipelines
+  work from different detectors back-to-back.
+
+EOS: each leg terminates on its own queue's (aggregated) EOS; the
+fan-in loop ends when every leg has. A leg error is raised to the
+consumer as soon as that leg winds down (its in-merge batches may be
+dropped — error paths are loud, not lossless), NOT deferred until the
+healthy detectors also finish: a dead detector in a continuous
+multi-run deployment must surface immediately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Mapping, Optional, Sequence, Tuple
+
+from psana_ray_tpu.infeed.batcher import Batch
+from psana_ray_tpu.infeed.pipeline import InfeedPipeline, drive_step
+from psana_ray_tpu.utils.metrics import PipelineMetrics
+
+
+@dataclasses.dataclass
+class DetectorStream:
+    """One detector's leg of the fan-in: its transport queue + batching
+    geometry. ``sharding`` places batches on the mesh (None = default
+    device)."""
+
+    name: str
+    queue: Any
+    batch_size: int
+    sharding: Any = None
+    prefetch_depth: int = 2
+    poll_interval_s: float = 0.01
+    max_wait_s: Optional[float] = None
+
+
+class FanInPipeline:
+    """Merge N detector streams into one consumer iterator.
+
+    Iteration yields ``(detector_name, device_batch)`` in arrival order
+    until EVERY stream has delivered EOS. ``run(steps)`` drives a mapping
+    of per-detector step callables and returns per-detector frame counts.
+    """
+
+    _DONE = object()
+
+    def __init__(self, streams: Sequence[DetectorStream], merge_depth: int = 2):
+        if not streams:
+            raise ValueError("need at least one DetectorStream")
+        names = [s.name for s in streams]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate detector names: {names}")
+        self.streams = list(streams)
+        self._pipes: Dict[str, InfeedPipeline] = {}
+        try:
+            for s in self.streams:
+                self._pipes[s.name] = InfeedPipeline(
+                    s.queue,
+                    s.batch_size,
+                    sharding=s.sharding,
+                    prefetch_depth=s.prefetch_depth,
+                    poll_interval_s=s.poll_interval_s,
+                    max_wait_s=s.max_wait_s,
+                )
+        except BaseException:
+            # a later leg failed to build; already-started legs are live
+            # threads draining real queues — stop them before surfacing
+            for pipe in self._pipes.values():
+                pipe.close()
+            raise
+        self.metrics: Dict[str, PipelineMetrics] = {
+            name: pipe.metrics for name, pipe in self._pipes.items()
+        }
+        # bounded so a stalled consumer backpressures every leg's
+        # prefetcher rather than buffering unbounded device arrays
+        self._merge: _queue.Queue = _queue.Queue(
+            maxsize=max(1, merge_depth) * len(self.streams)
+        )
+        self._stop = threading.Event()
+        self._errors: list = []
+        self._threads = [
+            threading.Thread(
+                target=self._pump, args=(s.name,), name=f"fanin-{s.name}", daemon=True
+            )
+            for s in self.streams
+        ]
+        self._live = len(self._threads)
+        for t in self._threads:
+            t.start()
+
+    def _pump(self, name: str):
+        pipe = self._pipes[name]
+        try:
+            for batch in pipe:
+                if not self._put((name, batch)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._errors.append(e)
+        finally:
+            pipe.close()
+            self._put((name, self._DONE), force=True)
+
+    def _put(self, item, force: bool = False) -> bool:
+        """Bounded put. A full queue backpressures (the consumer is
+        draining it); entries are only sacrificed to make room for a
+        forced DONE marker once the consumer is provably gone
+        (``close()`` set ``_stop`` and stopped draining)."""
+        while True:
+            stopped = self._stop.is_set()
+            if stopped and not force:
+                return False
+            try:
+                self._merge.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                if stopped and force:
+                    try:
+                        self._merge.get_nowait()
+                    except _queue.Empty:
+                        pass
+
+    def __iter__(self) -> Iterator[Tuple[str, Batch]]:
+        while self._live > 0:
+            try:
+                name, item = self._merge.get(timeout=0.05)
+            except _queue.Empty:
+                # a cross-thread close() may have drained DONE markers we
+                # were counting on — checking _stop here keeps a blocked
+                # consumer from waiting on markers that will never come
+                if self._stop.is_set():
+                    return
+                continue
+            if item is self._DONE:
+                self._live -= 1
+                if self._errors:
+                    raise self._errors[0]
+                continue
+            yield name, item
+
+    def close(self):
+        """Stop every leg (unblocking pump threads parked on starved
+        prefetchers) and release buffered batches."""
+        self._stop.set()
+        for pipe in self._pipes.values():
+            pipe.close()
+        try:
+            while True:
+                self._merge.get_nowait()
+        except _queue.Empty:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def run(
+        self,
+        steps: Mapping[str, Callable[[Batch], Any]],
+        on_result: Optional[Callable] = None,
+        block_until_ready: bool = False,
+    ) -> Dict[str, int]:
+        """Drive per-detector ``steps`` until every stream's EOS.
+
+        Each batch goes to ``steps[detector_name]``; unknown detectors
+        raise (a config error should be loud, not a silent drop). Returns
+        ``{detector_name: frames_processed}``.
+        """
+        missing = {s.name for s in self.streams} - set(steps)
+        if missing:
+            self.close()  # config error must not leave legs draining queues
+            raise KeyError(f"no step for detector(s): {sorted(missing)}")
+        counts = {s.name: 0 for s in self.streams}
+        try:
+            for name, batch in self:
+                out = drive_step(
+                    self.metrics[name], steps[name], batch, block_until_ready
+                )
+                counts[name] += batch.num_valid
+                if on_result is not None:
+                    on_result(name, out, batch)
+        finally:
+            self.close()
+        return counts

@@ -41,6 +41,7 @@ class DevicePrefetcher:
         sharding=None,
         prefetch_depth: int = 2,
         to_device: Optional[Callable[[Batch], Any]] = None,
+        stop_event: Optional[threading.Event] = None,
     ):
         if prefetch_depth < 1:
             raise ValueError("prefetch_depth must be >= 1")
@@ -49,7 +50,10 @@ class DevicePrefetcher:
         self._buf: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
         self._to_device = to_device or self._default_to_device
         self._err: Optional[BaseException] = None
-        self._stop = threading.Event()
+        # sharing the event with the source generator (batches_from_queue's
+        # ``stop``) lets close() cancel a poll loop the iterator protocol
+        # alone cannot interrupt
+        self._stop = stop_event if stop_event is not None else threading.Event()
         self._done = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -95,6 +99,13 @@ class DevicePrefetcher:
         except _queue.Empty:
             pass
         self._thread.join(timeout=timeout)
+        # wake any OTHER thread blocked in __next__ (fan-in pump threads
+        # iterate from their own thread): the producer thread is gone, so
+        # its end marker may have been drained above or never landed
+        try:
+            self._buf.put_nowait(None)
+        except _queue.Full:
+            pass
         self._done = True
 
     def __enter__(self):
@@ -118,6 +129,24 @@ class DevicePrefetcher:
         return item
 
 
+def drive_step(metrics: PipelineMetrics, step, batch, block_until_ready: bool = False):
+    """Run one consumer step over a device batch, recording frame count,
+    bytes, and step latency. ``block_until_ready`` makes the recorded
+    latency a true per-batch device latency instead of dispatch time —
+    the honest number for the <5 ms p50 target (BASELINE.md). Shared by
+    :meth:`InfeedPipeline.run` and ``FanInPipeline.run``."""
+    t0 = time.monotonic()
+    out = step(batch)
+    if block_until_ready:
+        out = jax.block_until_ready(out)
+    metrics.observe_batch(
+        batch.num_valid,
+        time.monotonic() - t0,
+        nbytes=int(getattr(batch.frames, "nbytes", 0)),
+    )
+    return out
+
+
 class InfeedPipeline:
     """transport queue -> batcher -> device prefetch -> step fn.
 
@@ -138,11 +167,19 @@ class InfeedPipeline:
         self.queue = queue
         self.batch_size = batch_size
         self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
+        stop = threading.Event()
         self._batches = batches_from_queue(
-            queue, batch_size, poll_interval_s=poll_interval_s, max_wait_s=max_wait_s
+            queue,
+            batch_size,
+            poll_interval_s=poll_interval_s,
+            max_wait_s=max_wait_s,
+            stop=stop,
         )
         self._prefetcher = DevicePrefetcher(
-            self._batches, sharding=sharding, prefetch_depth=prefetch_depth
+            self._batches,
+            sharding=sharding,
+            prefetch_depth=prefetch_depth,
+            stop_event=stop,
         )
 
     def __iter__(self) -> Iterator[Batch]:
@@ -174,15 +211,7 @@ class InfeedPipeline:
         n = 0
         try:
             for batch in self:
-                t0 = time.monotonic()
-                out = step(batch)
-                if block_until_ready:
-                    out = jax.block_until_ready(out)
-                self.metrics.observe_batch(
-                    batch.num_valid,
-                    time.monotonic() - t0,
-                    nbytes=int(getattr(batch.frames, "nbytes", 0)),
-                )
+                out = drive_step(self.metrics, step, batch, block_until_ready)
                 n += batch.num_valid
                 if on_result is not None:
                     on_result(out, batch)

@@ -1,0 +1,82 @@
+"""Worker process for the 2-process multi-host infeed test.
+
+Each process simulates one TPU host: 4 virtual CPU devices, its own local
+batch shard, one global mesh over all 8 devices. Run by
+tests/test_multihost.py as ``python multihost_worker.py <port> <rank>
+<nprocs>``; prints ``MULTIHOST OK`` on success.
+"""
+
+import os
+import re
+import sys
+
+
+def main():
+    port, rank, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    # 4 local devices per process (before any jax import); drop an
+    # inherited count (the parent pytest env forces 8)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # axon plugin ignores the env var
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=rank,
+    )
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from psana_ray_tpu.infeed.multihost import make_global_batch
+
+    assert jax.process_count() == nprocs, jax.process_count()
+    devices = jax.devices()
+    assert len(devices) == 4 * nprocs, devices
+    assert len(jax.local_devices()) == 4
+
+    mesh = Mesh(np.asarray(devices).reshape(2 * nprocs, 2), ("data", "model"))
+
+    b_local = 4
+    local = (
+        np.arange(b_local * 3 * 5, dtype=np.float32).reshape(b_local, 3, 5)
+        + 1000.0 * rank
+    )
+    g = make_global_batch(local, mesh)
+    assert g.shape == (b_local * nprocs, 3, 5), g.shape
+
+    # every addressable shard must hold rows from THIS host's local data
+    lo, hi = 1000.0 * rank, 1000.0 * rank + b_local * 3 * 5
+    for shard in g.addressable_shards:
+        vals = np.asarray(shard.data)
+        assert vals.min() >= lo and vals.max() < hi, (rank, vals.min(), vals.max())
+
+    # SPMD reduction across both hosts' shards (rides the collective path)
+    total = float(jax.jit(jnp.sum)(g))
+    expected = sum(
+        float(np.sum(np.arange(b_local * 3 * 5, dtype=np.float32) + 1000.0 * r))
+        for r in range(nprocs)
+    )
+    assert abs(total - expected) < 1e-3, (total, expected)
+
+    # model-axis replication: each data-group's shard pair is identical
+    if rank == 0:
+        by_row = {}
+        for shard in g.addressable_shards:
+            by_row.setdefault(shard.index[0], []).append(np.asarray(shard.data))
+        for row, datas in by_row.items():
+            for d in datas[1:]:
+                np.testing.assert_array_equal(datas[0], d)
+
+    print(f"MULTIHOST OK rank={rank} total={total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

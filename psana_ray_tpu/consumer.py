@@ -163,6 +163,11 @@ def main(argv=None):
     p.add_argument("--max_frames", type=int, default=None)
     p.add_argument("--quiet", action="store_true", help="suppress per-frame lines")
     p.add_argument("--log_level", default="INFO")
+    p.add_argument(
+        "--profile_dir", default=None,
+        help="capture a jax.profiler trace of the consume loop into this "
+        "directory (view in TensorBoard's Profile tab)",
+    )
     a = p.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, a.log_level.upper(), logging.INFO),
@@ -184,8 +189,12 @@ def main(argv=None):
         # frame, and SIGINT exits even while starved (no yield to reach)
         return stop or (a.max_frames is not None and n >= a.max_frames)
 
+    from psana_ray_tpu.utils.trace import trace
+
     try:
-        with DataReader(address=a.address, queue_name=a.queue_name, namespace=a.namespace) as reader:
+        with trace(a.profile_dir), DataReader(
+            address=a.address, queue_name=a.queue_name, namespace=a.namespace
+        ) as reader:
             for rec in reader.iter_records(stop=_should_stop):
                 n += 1
                 if not a.quiet:

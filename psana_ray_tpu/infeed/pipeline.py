@@ -21,6 +21,7 @@ import numpy as np
 
 from psana_ray_tpu.infeed.batcher import Batch, batches_from_queue
 from psana_ray_tpu.utils.metrics import PipelineMetrics
+from psana_ray_tpu.utils.trace import annotate
 
 
 class DevicePrefetcher:
@@ -59,6 +60,10 @@ class DevicePrefetcher:
         self._thread.start()
 
     def _default_to_device(self, batch: Batch):
+        with annotate("infeed.device_put"):
+            return self._place(batch)
+
+    def _place(self, batch: Batch):
         put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
         return dataclasses.replace(
             batch,
@@ -136,9 +141,10 @@ def drive_step(metrics: PipelineMetrics, step, batch, block_until_ready: bool = 
     the honest number for the <5 ms p50 target (BASELINE.md). Shared by
     :meth:`InfeedPipeline.run` and ``FanInPipeline.run``."""
     t0 = time.monotonic()
-    out = step(batch)
-    if block_until_ready:
-        out = jax.block_until_ready(out)
+    with annotate("pipeline.step"):
+        out = step(batch)
+        if block_until_ready:
+            out = jax.block_until_ready(out)
     metrics.observe_batch(
         batch.num_valid,
         time.monotonic() - t0,

@@ -1,0 +1,73 @@
+"""Device-level tracing: jax.profiler integration for the streaming path.
+
+The reference has no profiling story at all (SURVEY.md §5 — "no tracing,
+no timeline; debugging a slow consumer means print statements"). Counters
+and latency quantiles live in :mod:`psana_ray_tpu.utils.metrics`; this
+module adds the device timeline half: XLA/TPU traces viewable in
+TensorBoard or Perfetto (``tensorboard --logdir <dir>`` -> Profile tab).
+
+Two surfaces:
+
+- :func:`trace` — context manager capturing a device trace of the
+  enclosed block (producer/consumer loops, a bench section);
+- :func:`annotate` — named region that shows up on the trace timeline
+  (wrap one pipeline stage: batch assembly, device put, step dispatch).
+
+Both degrade to no-ops when profiling is unavailable (e.g. a stripped
+CPU wheel) so production paths can leave the calls in place.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``logdir``.
+
+    ``logdir=None`` disables tracing (zero overhead) so callers can wire
+    an optional ``--profile_dir`` flag straight through. Traces from
+    repeated runs land in distinct subdirectories (timestamped) the way
+    TensorBoard expects.
+    """
+    if not logdir:
+        yield
+        return
+    import jax
+
+    path = os.path.join(logdir, time.strftime("%Y%m%d-%H%M%S"))
+    try:
+        jax.profiler.start_trace(path)
+    except Exception as e:  # pragma: no cover - backend without profiler
+        logger.warning("device tracing unavailable: %r", e)
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+            logger.info("device trace written to %s", path)
+        except Exception as e:  # pragma: no cover
+            logger.warning("stopping device trace failed: %r", e)
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (host + device annotation).
+
+    Usable as context manager. No-op outside an active
+    trace; safe to leave in hot loops (TraceAnnotation is a thin RAII
+    wrapper around a TraceMe)."""
+    import jax
+
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - backend without profiler
+        return contextlib.nullcontext()

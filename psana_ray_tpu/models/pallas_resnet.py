@@ -46,7 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 _BF16 = jnp.bfloat16
 # leave headroom under the ~16 MB/core VMEM for compiler-managed buffers
-_VMEM_BUDGET = 14 * 1024 * 1024
+_VMEM_BUDGET = 13 * 1024 * 1024
 
 
 def _downsample(a: jax.Array, s: int, r: int, c: int, ch: int) -> jax.Array:
@@ -84,16 +84,28 @@ def _col_mask(a: jax.Array, rows: int, cols_buf: int, cols_true: int, ch: int):
     return jnp.where(keep, a, jnp.zeros((), a.dtype))
 
 
-def _bottleneck_kernel(*refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr, cro):
+def _bottleneck_kernel(
+    *refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr, cro, emit="full"
+):
     """See module docstring. Alignment note: sliced HBM<->VMEM DMAs require
     the last dim to be a multiple of 128 and the second-to-last a multiple
     of 8 (Mosaic tiling), so channel dims are zero-padded to 128 and width
     dims to 8 — with zeroed affine rows on padded channels and explicit
-    column masks, padding is numerically exact, not approximate."""
+    column masks, padding is numerically exact, not approximate.
+
+    ``emit='full'`` runs the whole block; ``emit='y2'`` is the FRONT half
+    of a split block (conv1x1 -> affine -> silu -> conv3x3 -> affine ->
+    silu, output y2) used when a block's resident weights don't fit VMEM
+    alongside its activations (stage-4 projection block: w1+w2+w3+wp is
+    ~12 MB); :func:`_back_kernel` finishes (1x1 + residual + silu)."""
     s = stride
     ho, wo = h // s, wi // s  # true output extents
     wo_buf = _up(wo, 8)
-    if proj:
+    if emit == "y2":
+        (x_h, w1_h, w2_h, s1, b1, s2, b2, out_h,
+         x_v, w1_v, w2_v, y1p_v, out_v, sem) = refs
+        w3_h = wp_h = w3_v = wp_v = s3 = b3 = sp = bp = None
+    elif proj:
         (x_h, w1_h, w2_h, w3_h, wp_h, s1, b1, s2, b2, s3, b3, sp, bp, out_h,
          x_v, w1_v, w2_v, w3_v, wp_v, y1p_v, out_v, sem) = refs
     else:
@@ -105,9 +117,10 @@ def _bottleneck_kernel(*refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr,
 
     @pl.when(b == 0)
     def _load_weights():
-        for src, dst in ((w1_h, w1_v), (w2_h, w2_v), (w3_h, w3_v)) + (
-            ((wp_h, wp_v),) if proj else ()
-        ):
+        pairs = ((w1_h, w1_v), (w2_h, w2_v))
+        if emit == "full":
+            pairs += ((w3_h, w3_v),) + (((wp_h, wp_v),) if proj else ())
+        for src, dst in pairs:
             cp = pltpu.make_async_copy(src, dst, sem)
             cp.start()
             cp.wait()
@@ -125,10 +138,19 @@ def _bottleneck_kernel(*refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr,
     # buffer carries extra trailing rows/cols so strided tap slices (which
     # over-read rows/cols the downsample or column mask discards) stay in
     # bounds.
+    #
+    # Row-chunk loops are lax.fori_loop, not Python-unrolled: Mosaic's
+    # scoped-vmem stack allocator charges each unrolled iteration's
+    # temporaries separately (an unrolled stage-3 block blows the 16 MB
+    # limit), while a fori body's stack is reused across iterations. The
+    # dynamic chunk offsets index the LEADING (row) dim of 3D VMEM refs —
+    # untiled, so no sublane/lane alignment constraint applies.
     off = 0 if s == 1 else 1
     y1p_v[:] = jnp.zeros((h + s + 1, wib + s + 1, f), _BF16)
-    for r0 in range(0, h, cr):
-        xa = x_v[r0:r0 + cr]  # [cr, wib, cin]
+
+    def _y1_body(i, carry):
+        r0 = i * cr
+        xa = x_v[pl.ds(r0, cr)]  # [cr, wib, cin]
         acc = jnp.dot(
             xa.reshape(cr * wib, cin), w1_v[:], preferred_element_type=jnp.float32
         )
@@ -136,28 +158,35 @@ def _bottleneck_kernel(*refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr,
         # cols >= wi would otherwise hold silu(bias) != 0 and leak into the
         # 3x3 taps at the true right edge — mask them to honor SAME padding
         y1 = _col_mask(y1.reshape(cr, wib, f), cr, wib, wi, f)
-        y1p_v[1 + r0:1 + r0 + cr, 1:1 + wib] = y1
+        y1p_v[pl.ds(1 + r0, cr), 1:1 + wib] = y1
+        return carry
+
+    jax.lax.fori_loop(0, h // cr, _y1_body, 0, unroll=False)
 
     # conv3x3(stride) + affine + silu, conv1x1 + affine, residual, silu —
     # chunked over output rows to bound the f32 accumulators
-    for ro in range(0, ho, cro):
+    def _out_body(i, carry):
+        ro = i * cro
         acc2 = jnp.zeros((cro * wo_buf, f), jnp.float32)
         for t in range(9):
             dy, dx = divmod(t, 3)
-            r0 = s * ro + dy + off
             c0 = dx + off
-            raw = y1p_v[r0:r0 + s * cro, c0:c0 + s * wo_buf]
+            raw = y1p_v[pl.ds(s * ro + dy + off, s * cro), c0:c0 + s * wo_buf]
             patch = _downsample(raw, s, cro, wo_buf, f)
             acc2 += jnp.dot(
                 patch.reshape(cro * wo_buf, f), w2_v[t],
                 preferred_element_type=jnp.float32,
             )
         y2 = jax.nn.silu(acc2 * s2[:] + b2[:]).astype(_BF16)
+        if emit == "y2":
+            y2m = _col_mask(y2.reshape(cro, wo_buf, f), cro, wo_buf, wo, f)
+            out_v[pl.ds(ro, cro)] = y2m
+            return carry
         y3 = jnp.dot(y2, w3_v[:], preferred_element_type=jnp.float32)
         y3 = y3 * s3[:] + b3[:]
         if proj:
             xs = _downsample(
-                x_v[s * ro:s * ro + s * cro, 0:s * wo_buf], s, cro, wo_buf, cin
+                x_v[pl.ds(s * ro, s * cro), 0:s * wo_buf], s, cro, wo_buf, cin
             )
             res = jnp.dot(
                 xs.reshape(cro * wo_buf, cin), wp_v[:],
@@ -165,7 +194,7 @@ def _bottleneck_kernel(*refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr,
             )
             res = res * sp[:] + bp[:]
         else:
-            xr = x_v[ro:ro + cro, 0:wo_buf]
+            xr = x_v[pl.ds(ro, cro), 0:wo_buf]
             if cin != cout:
                 # toy configs only (cout < 128-lane pad): unaligned lane
                 # slice — fine in interpret mode, unsupported by Mosaic.
@@ -174,7 +203,73 @@ def _bottleneck_kernel(*refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr,
             res = xr.reshape(cro * wo_buf, cout).astype(jnp.float32)
         out = jax.nn.silu(y3 + res).astype(_BF16)
         out = _col_mask(out.reshape(cro, wo_buf, cout), cro, wo_buf, wo, cout)
-        out_v[ro:ro + cro] = out
+        out_v[pl.ds(ro, cro)] = out
+        return carry
+
+    jax.lax.fori_loop(0, ho // cro, _out_body, 0, unroll=False)
+
+    cp = pltpu.make_async_copy(out_v, out_h.at[b], sem)
+    cp.start()
+    cp.wait()
+
+
+def _back_kernel(
+    *refs, cin, f, cout, h, wib, w_dma, stride, proj, ho, wo, wo_buf, cb
+):
+    """Back half of a split bottleneck: y2 @ w3 -> affine -> (+ residual /
+    projection) -> silu. Resident weights here are only w3 (+wp), so the
+    two halves each fit VMEM where the fused kernel cannot."""
+    s = stride
+    if proj:
+        (y2_h, x_h, w3_h, wp_h, s3, b3, sp, bp, out_h,
+         y2_v, x_v, w3_v, wp_v, out_v, sem) = refs
+    else:
+        (y2_h, x_h, w3_h, s3, b3, out_h, y2_v, x_v, w3_v, out_v, sem) = refs
+        wp_h = wp_v = sp = bp = None
+
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _load_weights():
+        for src, dst in ((w3_h, w3_v),) + (((wp_h, wp_v),) if proj else ()):
+            cp = pltpu.make_async_copy(src, dst, sem)
+            cp.start()
+            cp.wait()
+
+    if wib > w_dma:
+        x_v[:] = jnp.zeros((h, wib, cin), _BF16)
+    cp = pltpu.make_async_copy(x_h.at[b], x_v.at[:, 0:w_dma], sem)
+    cp.start()
+    cp.wait()
+    cp = pltpu.make_async_copy(y2_h.at[b], y2_v, sem)
+    cp.start()
+    cp.wait()
+
+    def _body(i, carry):
+        ro = i * cb
+        y2c = y2_v[pl.ds(ro, cb)].reshape(cb * wo_buf, f)
+        y3 = jnp.dot(y2c, w3_v[:], preferred_element_type=jnp.float32)
+        y3 = y3 * s3[:] + b3[:]
+        if proj:
+            xs = _downsample(
+                x_v[pl.ds(s * ro, s * cb), 0:s * wo_buf], s, cb, wo_buf, cin
+            )
+            res = jnp.dot(
+                xs.reshape(cb * wo_buf, cin), wp_v[:],
+                preferred_element_type=jnp.float32,
+            )
+            res = res * sp[:] + bp[:]
+        else:
+            xr = x_v[pl.ds(ro, cb), 0:wo_buf]
+            if cin != cout:
+                xr = jax.lax.slice(xr, (0, 0, 0), (cb, wo_buf, cout))
+            res = xr.reshape(cb * wo_buf, cout).astype(jnp.float32)
+        out = jax.nn.silu(y3 + res).astype(_BF16)
+        out = _col_mask(out.reshape(cb, wo_buf, cout), cb, wo_buf, wo, cout)
+        out_v[pl.ds(ro, cb)] = out
+        return carry
+
+    jax.lax.fori_loop(0, ho // cb, _body, 0, unroll=False)
 
     cp = pltpu.make_async_copy(out_v, out_h.at[b], sem)
     cp.start()
@@ -238,17 +333,85 @@ def fused_bottleneck(
         + w1.size * 2 + w2.size * 2 + w3.size * 2
         + (wp.size * 2 if proj else 0)
     )
-    budget = max(256 * 1024, (_VMEM_BUDGET - fixed) // 3)
-    cr = _pick_chunk(h, wib * f * 4, budget)
-    cro = _pick_chunk(ho, wo_buf * cout * 4, budget)
+    # per-row live-set estimates for one fori iteration (f32 accumulator +
+    # bf16 activation temps in the y1 loop; acc2/y3/res f32s + patch/out
+    # bf16 temps in the out loop) — the loop body's stack is reused across
+    # iterations, so only ONE iteration's temps must fit the budget
+    budget = max(256 * 1024, _VMEM_BUDGET - fixed)
+    cr = _pick_chunk(h, wib * f * 8, budget)
+    cro = _pick_chunk(ho, wo_buf * (8 * f + 10 * cout), budget)
+
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    if fixed > _VMEM_BUDGET - (1 << 20):
+        # resident weights + activations can't share VMEM with any useful
+        # temp budget (stage-4 projection: w1+w2+w3+wp ~12 MB): run the
+        # block as TWO kernels, each holding only its half of the weights
+        front = functools.partial(
+            _bottleneck_kernel,
+            cin=cin, f=f, cout=cout, h=h, wi=wi, wib=wib, w_dma=w_dma,
+            stride=s, proj=proj, cr=cr, cro=cro, emit="y2",
+        )
+        y2 = pl.pallas_call(
+            front,
+            grid=(bsz,),
+            in_specs=[any_spec] * 3 + [vmem] * 4,
+            out_specs=any_spec,
+            out_shape=jax.ShapeDtypeStruct((bsz, ho, wo_buf, f), _BF16),
+            scratch_shapes=[
+                pltpu.VMEM((h, wib, cin), _BF16),
+                pltpu.VMEM(w1.shape, _BF16),
+                pltpu.VMEM(w2.shape, _BF16),
+                pltpu.VMEM((h + s + 1, wib + s + 1, f), _BF16),
+                pltpu.VMEM((ho, wo_buf, f), _BF16),
+                pltpu.SemaphoreType.DMA,
+            ],
+            interpret=interpret,
+        )(x, w1, w2, s1, b1, s2, b2)
+
+        back_fixed = (
+            ho * wo_buf * f * 2 + h * wib * cin * 2 + ho * wo_buf * cout * 2
+            + w3.size * 2 + (wp.size * 2 if proj else 0)
+        )
+        cb = _pick_chunk(
+            ho,
+            wo_buf * (2 * f + 10 * cout),
+            max(256 * 1024, _VMEM_BUDGET - back_fixed),
+        )
+        back = functools.partial(
+            _back_kernel,
+            cin=cin, f=f, cout=cout, h=h, wib=wib, w_dma=w_dma,
+            stride=s, proj=proj, ho=ho, wo=wo, wo_buf=wo_buf, cb=cb,
+        )
+        back_ops = [y2, x, w3] + ([wp] if proj else [])
+        back_ops += [s3, b3, *rest] if proj else [s3, b3]
+        back_scratch = [
+            pltpu.VMEM((ho, wo_buf, f), _BF16),
+            pltpu.VMEM((h, wib, cin), _BF16),
+            pltpu.VMEM(w3.shape, _BF16),
+        ]
+        if proj:
+            back_scratch.append(pltpu.VMEM(wp.shape, _BF16))
+        back_scratch += [
+            pltpu.VMEM((ho, wo_buf, cout), _BF16),
+            pltpu.SemaphoreType.DMA,
+        ]
+        return pl.pallas_call(
+            back,
+            grid=(bsz,),
+            in_specs=[any_spec] * (4 if proj else 3) + [vmem] * (4 if proj else 2),
+            out_specs=any_spec,
+            out_shape=jax.ShapeDtypeStruct((bsz, ho, wo_buf, cout), _BF16),
+            scratch_shapes=back_scratch,
+            interpret=interpret,
+        )(*back_ops)
 
     kernel = functools.partial(
         _bottleneck_kernel,
         cin=cin, f=f, cout=cout, h=h, wi=wi, wib=wib, w_dma=w_dma,
         stride=s, proj=proj, cr=cr, cro=cro,
     )
-    any_spec = pl.BlockSpec(memory_space=pl.ANY)
-    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     n_aff = 8 if proj else 6
     in_specs = [any_spec] * (5 if proj else 4) + [vmem] * n_aff
     operands = [x, w1, w2, w3] + ([wp] if proj else [])

@@ -48,6 +48,25 @@ class TestFusedBottleneck:
         ],
     )
     def test_matches_flax_block(self, rng, cin, f, stride, proj):
+        self._check_block(rng, cin, f, stride, proj)
+
+    @pytest.mark.parametrize(
+        "cin,f,stride,proj",
+        [
+            (64, 16, 1, False),
+            (64, 32, 2, True),
+        ],
+    )
+    def test_split_path_matches_flax_block(self, rng, monkeypatch, cin, f, stride, proj):
+        """Starve the VMEM budget so the block takes the two-kernel split
+        path (front conv1+conv3x3 | back conv1x1+residual) — the route
+        real stage-4 projection blocks compile through."""
+        import psana_ray_tpu.models.pallas_resnet as pr
+
+        monkeypatch.setattr(pr, "_VMEM_BUDGET", 1 << 20)
+        self._check_block(rng, cin, f, stride, proj)
+
+    def _check_block(self, rng, cin, f, stride, proj):
         h = w = 16
         block = BottleneckBlock(
             features=f, strides=(stride, stride), norm="frozen"

@@ -217,6 +217,86 @@ int64_t shmring_get(void* handle, uint8_t* out, uint64_t out_cap) {
   }
 }
 
+// ---- zero-copy variants ----------------------------------------------
+//
+// put/get above copy through a caller buffer; for MB-scale frames the
+// Python side then pays several more copies (bytes assembly, ctypes
+// buffer, decode). reserve/commit + acquire/release expose the slot
+// memory itself so Python writes/reads payloads in place (numpy copyto:
+// ONE memcpy each way). Claim safety is identical to put/get — the slot
+// is claimed with the same head/tail CAS before the pointer is handed
+// out. Tradeoff: a process that crashes between claim and
+// commit/release leaves that slot permanently in-flight and the ring
+// eventually wedges on it; the copying put/get have the same window,
+// just narrower (their memcpy). Crash recovery is destroy + recreate.
+
+// rc: 1 = claimed (out_ptr -> slot payload, ticket -> pass to commit),
+// 0 = full, -2 = closed.
+int shmring_reserve(void* handle, uint8_t** out_ptr, uint64_t* ticket) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  if (h->closed.load(std::memory_order_acquire)) return -2;
+  uint64_t pos = h->head.load(std::memory_order_relaxed);
+  for (;;) {
+    Slot* s = slot_at(r, pos);
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+    if (dif == 0) {
+      if (h->head.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed)) {
+        *out_ptr = reinterpret_cast<uint8_t*>(s) + sizeof(Slot);
+        *ticket = pos;
+        return 1;
+      }
+    } else if (dif < 0) {
+      h->n_put_rejected.fetch_add(1, std::memory_order_relaxed);
+      return 0;  // full
+    } else {
+      pos = h->head.load(std::memory_order_relaxed);
+    }
+  }
+}
+
+void shmring_commit(void* handle, uint64_t ticket, uint64_t len) {
+  Ring* r = static_cast<Ring*>(handle);
+  Slot* s = slot_at(r, ticket);
+  s->len = (uint32_t)len;
+  s->seq.store(ticket + 1, std::memory_order_release);
+  r->hdr->n_put.fetch_add(1, std::memory_order_relaxed);
+}
+
+// rc: payload length >= 0 (out_ptr -> slot payload, ticket -> pass to
+// release), -1 = empty, -2 = closed.
+int64_t shmring_acquire(void* handle, const uint8_t** out_ptr, uint64_t* ticket) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  if (h->closed.load(std::memory_order_acquire)) return -2;
+  uint64_t pos = h->tail.load(std::memory_order_relaxed);
+  for (;;) {
+    Slot* s = slot_at(r, pos);
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+    if (dif == 0) {
+      if (h->tail.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed)) {
+        *out_ptr = reinterpret_cast<uint8_t*>(s) + sizeof(Slot);
+        *ticket = pos;
+        return (int64_t)s->len;
+      }
+    } else if (dif < 0) {
+      if (h->closed.load(std::memory_order_acquire)) return -2;
+      return -1;  // empty
+    } else {
+      pos = h->tail.load(std::memory_order_relaxed);
+    }
+  }
+}
+
+void shmring_release(void* handle, uint64_t ticket) {
+  Ring* r = static_cast<Ring*>(handle);
+  Slot* s = slot_at(r, ticket);
+  s->seq.store(ticket + r->hdr->capacity, std::memory_order_release);
+  r->hdr->n_get.fetch_add(1, std::memory_order_relaxed);
+}
+
 uint64_t shmring_size(void* handle) {
   Header* h = static_cast<Ring*>(handle)->hdr;
   uint64_t head = h->head.load(std::memory_order_acquire);

@@ -252,13 +252,57 @@ class EosTally:
 
 
 def decode(buf: bytes):
-    """Decode a wire message into FrameRecord or EndOfStream."""
+    """Decode a wire message into FrameRecord or EndOfStream. Accepts any
+    buffer protocol object (bytes, memoryview into shared memory, ...);
+    the returned record owns its data (panels are copied out)."""
     (magic,) = struct.unpack_from("<I", buf, 0)
     if magic == _FRAME_MAGIC:
         return FrameRecord.from_bytes(buf)
     if magic == _EOS_MAGIC:
         return EndOfStream.from_bytes(buf)
     raise ValueError(f"unknown wire magic {magic:#x}")
+
+
+def encoded_size(item) -> int:
+    """Exact wire size of ``to_bytes()`` without building it — lets a
+    zero-copy transport reserve the right slot span up front."""
+    if isinstance(item, FrameRecord):
+        return _FRAME_HEADER.size + 8 * item.panels.ndim + int(item.panels.nbytes)
+    if isinstance(item, EndOfStream):
+        return _EOS_HEADER.size
+    raise TypeError(f"not a wire record: {type(item)!r}")
+
+
+def encode_into(item, buf) -> int:
+    """Serialize ``item`` directly into a writable buffer (e.g. a shm ring
+    slot), avoiding the intermediate bytes of ``to_bytes()``. The frame
+    payload lands via ONE ``np.copyto`` memcpy. Returns bytes written."""
+    mv = memoryview(buf)
+    if isinstance(item, EndOfStream):
+        data = item.to_bytes()  # header-only, tiny
+        mv[: len(data)] = data
+        return len(data)
+    if not isinstance(item, FrameRecord):
+        raise TypeError(f"not a wire record: {type(item)!r}")
+    panels = np.ascontiguousarray(item.panels)
+    _FRAME_HEADER.pack_into(
+        mv,
+        0,
+        _FRAME_MAGIC,
+        item.schema_version,
+        item.shard_rank,
+        item.event_idx,
+        panels.ndim,
+        _DTYPE_CODES[panels.dtype],
+        float(item.photon_energy),
+        float(item.timestamp),
+    )
+    off = _FRAME_HEADER.size
+    struct.pack_into(f"<{panels.ndim}q", mv, off, *panels.shape)
+    off += 8 * panels.ndim
+    dst = np.frombuffer(mv, dtype=panels.dtype, count=panels.size, offset=off)
+    np.copyto(dst, panels.reshape(-1))
+    return off + int(panels.nbytes)
 
 
 def is_eos(item) -> bool:

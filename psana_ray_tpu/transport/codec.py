@@ -1,0 +1,39 @@
+"""Tagged payload codec shared by the byte-oriented transports (shm, TCP).
+
+One leading tag byte selects the codec: ``R`` = records wire format
+(:mod:`psana_ray_tpu.records` — FrameRecord/EndOfStream), ``P`` = pickle
+(arbitrary Python objects), ``V`` = void (a slot committed by a producer
+whose encode failed mid-write; consumers skip it). The zero-copy shm path
+writes tag + record directly into slot memory (`shm_ring.put`); this
+module provides the bytes-building variant for transports that need a
+contiguous payload (TCP framing) and the shared decoder.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord, decode
+
+TAG_RECORD = b"R"
+TAG_PICKLE = b"P"
+TAG_VOID = b"V"
+
+
+def encode_payload(item: Any) -> bytes:
+    if isinstance(item, (FrameRecord, EndOfStream)):
+        return TAG_RECORD + item.to_bytes()
+    return TAG_PICKLE + pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_payload(buf) -> Any:
+    """Decode a tagged payload; accepts bytes or memoryview. Returned
+    records own their data (panels copied out of ``buf``)."""
+    tag = bytes(buf[:1])
+    body = buf[1:]
+    if tag == TAG_RECORD:
+        return decode(body)
+    if tag == TAG_PICKLE:
+        return pickle.loads(body)
+    raise ValueError(f"unknown payload tag {tag!r}")

@@ -25,16 +25,16 @@ import threading
 import time
 from typing import Any, List, Optional
 
-import numpy as np
-
-from psana_ray_tpu.records import EndOfStream, FrameRecord, decode
+from psana_ray_tpu.records import EndOfStream, FrameRecord, encode_into, encoded_size
+from psana_ray_tpu.transport.codec import TAG_PICKLE as _TAG_PICKLE
+from psana_ray_tpu.transport.codec import TAG_RECORD as _TAG_RECORD
+from psana_ray_tpu.transport.codec import TAG_VOID as _TAG_VOID
+from psana_ray_tpu.transport.codec import decode_payload
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libshmring.so")
-_TAG_RECORD = b"R"  # records wire format
-_TAG_PICKLE = b"P"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -113,6 +113,20 @@ def _load_lib() -> ctypes.CDLL:
         for fn in ("shmring_size", "shmring_capacity", "shmring_slot_bytes"):
             getattr(lib, fn).restype = ctypes.c_uint64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.shmring_reserve.restype = ctypes.c_int
+        lib.shmring_reserve.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.shmring_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.shmring_acquire.restype = ctypes.c_int64
+        lib.shmring_acquire.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.shmring_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.shmring_is_closed.restype = ctypes.c_int
         lib.shmring_is_closed.argtypes = [ctypes.c_void_p]
         lib.shmring_close.argtypes = [ctypes.c_void_p]
@@ -141,7 +155,9 @@ class ShmRingBuffer:
         self.name = name
         self._owner = owner
         self._lib = _load_lib()
-        self._recv = ctypes.create_string_buffer(int(self._lib.shmring_slot_bytes(handle)))
+        # immutable after creation; cached so put()/put_wait spins skip
+        # the FFI round trip
+        self._slot_bytes = int(self._lib.shmring_slot_bytes(handle))
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -177,29 +193,61 @@ class ShmRingBuffer:
         return f"/psana_ray_tpu_{clean}".encode()
 
     # -- transport contract ----------------------------------------------
+    # put/get serialize straight into / out of the claimed slot memory
+    # (shmring_reserve/commit + acquire/release): a FrameRecord costs ONE
+    # numpy memcpy each way instead of the bytes-assembly + ctypes-buffer
+    # + decode-copy chain (measured 38 -> ~300 fps on 8.6 MB epix frames).
     def put(self, item: Any) -> bool:
-        payload = self._encode(item)
-        rc = self._lib.shmring_put(self._h, payload, len(payload))
-        if rc == 1:
-            return True
+        wire = isinstance(item, (FrameRecord, EndOfStream))
+        slot_bytes = self._slot_bytes
+        if wire:
+            n = 1 + encoded_size(item)
+            payload = None
+        else:
+            payload = _TAG_PICKLE + pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+            n = len(payload)
+        if n > slot_bytes:
+            raise ValueError(f"message of {n} bytes exceeds slot size {slot_bytes}")
+        ptr = ctypes.c_void_p()
+        ticket = ctypes.c_uint64()
+        rc = self._lib.shmring_reserve(self._h, ctypes.byref(ptr), ctypes.byref(ticket))
         if rc == 0:
             return False
         if rc == -2:
             raise TransportClosed(f"shm ring {self.name!r} is closed")
-        raise ValueError(
-            f"message of {len(payload)} bytes exceeds slot size "
-            f"{int(self._lib.shmring_slot_bytes(self._h))}"
-        )
+        mv = memoryview((ctypes.c_ubyte * slot_bytes).from_address(ptr.value)).cast("B")
+        ok = False
+        try:
+            if wire:
+                mv[0:1] = _TAG_RECORD
+                encode_into(item, mv[1:n])
+            else:
+                mv[:n] = payload
+            ok = True
+        finally:
+            # always publish the claimed slot — an unreleased claim would
+            # wedge every consumer at this position forever. A failed
+            # encode publishes a 1-byte void marker consumers skip.
+            if not ok:
+                mv[0:1] = _TAG_VOID
+            self._lib.shmring_commit(self._h, ticket, n if ok else 1)
+        return True
 
     def get(self) -> Any:
-        n = self._lib.shmring_get(self._h, self._recv, len(self._recv))
+        ptr = ctypes.c_void_p()
+        ticket = ctypes.c_uint64()
+        n = self._lib.shmring_acquire(self._h, ctypes.byref(ptr), ctypes.byref(ticket))
         if n == -1:
             return EMPTY
         if n == -2:
             raise TransportClosed(f"shm ring {self.name!r} is closed")
-        if n == -3:
-            raise RuntimeError("receive buffer smaller than message (corrupt ring?)")
-        return self._decode(self._recv.raw[: int(n)])
+        try:
+            mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
+            if bytes(mv[:1]) == _TAG_VOID:  # producer-side encode failure
+                return EMPTY
+            return self._decode(mv)
+        finally:
+            self._lib.shmring_release(self._h, ticket)
 
     def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.0002) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -278,16 +326,5 @@ class ShmRingBuffer:
 
     # -- payload codec ----------------------------------------------------
     @staticmethod
-    def _encode(item: Any) -> bytes:
-        if isinstance(item, (FrameRecord, EndOfStream)):
-            return _TAG_RECORD + item.to_bytes()
-        return _TAG_PICKLE + pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
-
-    @staticmethod
-    def _decode(buf: bytes) -> Any:
-        tag, body = buf[:1], buf[1:]
-        if tag == _TAG_RECORD:
-            return decode(body)
-        if tag == _TAG_PICKLE:
-            return pickle.loads(body)
-        raise ValueError(f"unknown payload tag {tag!r}")
+    def _decode(buf) -> Any:
+        return decode_payload(buf)  # copies panels out of the slot view

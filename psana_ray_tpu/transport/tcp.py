@@ -40,7 +40,7 @@ from typing import Any, List, Optional
 
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY, RingBuffer
-from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
+from psana_ray_tpu.transport.codec import decode_payload as _decode, encode_payload as _encode
 
 _OP_PUT = b"P"
 _OP_GET = b"G"
@@ -53,8 +53,6 @@ _ST_NO = b"0"
 _ST_CLOSED = b"X"
 _ST_ERR = b"E"
 
-_encode = ShmRingBuffer._encode
-_decode = ShmRingBuffer._decode
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

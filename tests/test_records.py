@@ -102,3 +102,50 @@ class TestEosAggregation:
         t.observe(eos)
         assert t.is_duplicate(eos)
         assert not t.is_duplicate(EndOfStream(producer_rank=1, shards_done=1, total_shards=2))
+
+
+class TestZeroCopyCodec:
+    """encode_into/encoded_size must produce byte-identical wire data to
+    to_bytes() (the zero-copy shm path depends on it)."""
+
+    def test_frame_encode_into_matches_to_bytes(self, rng):
+        import numpy as np
+
+        from psana_ray_tpu.records import FrameRecord, decode, encode_into, encoded_size
+
+        rec = FrameRecord(3, 77, rng.normal(size=(2, 8, 8)).astype(np.float32), 9.1,
+                          timestamp=123.5)
+        ref = rec.to_bytes()
+        n = encoded_size(rec)
+        assert n == len(ref)
+        buf = bytearray(n + 16)
+        written = encode_into(rec, memoryview(buf)[:n])
+        assert written == n
+        assert bytes(buf[:n]) == ref
+        back = decode(memoryview(buf)[:n])
+        assert back.equals(rec)
+
+    def test_eos_encode_into_matches_to_bytes(self):
+        from psana_ray_tpu.records import EndOfStream, decode, encode_into, encoded_size
+
+        eos = EndOfStream(producer_rank=2, total_events=50, shards_done=3, total_shards=8)
+        ref = eos.to_bytes()
+        n = encoded_size(eos)
+        assert n == len(ref)
+        buf = bytearray(n)
+        assert encode_into(eos, memoryview(buf)) == n
+        assert bytes(buf) == ref
+        back = decode(memoryview(buf))
+        assert back == eos
+
+    def test_non_contiguous_panels(self, rng):
+        import numpy as np
+
+        from psana_ray_tpu.records import FrameRecord, decode, encode_into, encoded_size
+
+        big = rng.normal(size=(4, 8, 16)).astype(np.float32)
+        rec = FrameRecord(0, 1, big[:, :, ::2], 8.0)  # strided view
+        n = encoded_size(rec)
+        buf = bytearray(n)
+        encode_into(rec, memoryview(buf))
+        assert decode(memoryview(buf)).equals(rec)

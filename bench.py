@@ -310,7 +310,7 @@ def _bench_unet(jax, jnp, calib, x_warm, x_fresh, extras):
     from psana_ray_tpu.models.peaks import find_peaks
 
     b_unet = 2  # frames per batch; panels fold into batch: [2*16, H, W, 1]
-    model = PeakNetUNet()
+    model = PeakNetUNet(norm="frozen")  # inference form, folded stats
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
         variables = jax.jit(model.init)(jax.random.key(0), jnp.zeros((1, 64, 64, 1)))

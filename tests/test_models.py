@@ -105,3 +105,56 @@ class TestLosses:
         full = masked_sigmoid_focal(logits, targets, jnp.asarray([1, 1, 0]))
         sub = masked_sigmoid_focal(logits[:2], targets[:2], jnp.asarray([1, 1]))
         assert float(full) == pytest.approx(float(sub), rel=1e-5)
+
+
+class TestMergeBlockEquivalence:
+    def test_split_weights_equal_concat_conv(self, rng):
+        """conv_a(up) + conv_b(skip) must equal conv(concat([up, skip]))
+        with the kernel stitched along its input-channel axis — the
+        identity MergeBlock relies on to skip the concat copy."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from flax.core import meta
+
+        from psana_ray_tpu.models.unet import MergeBlock
+
+        f = 8
+        up = jnp.asarray(rng.normal(size=(2, 8, 8, f)).astype(np.float32))
+        skip = jnp.asarray(rng.normal(size=(2, 8, 8, f)).astype(np.float32))
+        block = MergeBlock(features=f, dtype=jnp.float32, norm="frozen")
+        variables = block.init(jax.random.key(0), up, skip)
+        got = block.apply(variables, up, skip)
+
+        p = meta.unbox(variables)["params"]
+        k = jnp.concatenate(
+            [p["merge_up"]["kernel"], p["merge_skip"]["kernel"]], axis=2
+        )  # [3,3,2f,f]
+        y = jax.lax.conv_general_dilated(
+            jnp.concatenate([up, skip], axis=-1), k, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        aff0 = p["FrozenAffine_0"]
+        y = y * aff0["scale"] + aff0["bias"]
+        y = jax.nn.silu(y)
+        y = jax.lax.conv_general_dilated(
+            y, p["Conv_0"]["kernel"], (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        aff1 = p["FrozenAffine_1"]
+        ref = jax.nn.silu(y * aff1["scale"] + aff1["bias"])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_unet_frozen_norm_runs(self, rng):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from psana_ray_tpu.models import PeakNetUNet
+
+        model = PeakNetUNet(features=(8, 16), norm="frozen")
+        x = jnp.asarray(rng.normal(size=(2, 16, 16, 1)).astype(np.float32))
+        v = model.init(jax.random.key(0), x)
+        out = model.apply(v, x)
+        assert out.shape == (2, 16, 16, 1)
+        assert np.isfinite(np.asarray(out)).all()

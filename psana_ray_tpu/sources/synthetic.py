@@ -125,6 +125,12 @@ class SyntheticSource:
             data = img
         else:
             raise ValueError(f"unknown mode {mode!r}")
+        if np.issubdtype(self.dtype, np.integer):
+            # detector-native integer ADUs: clip before the cast — common
+            # mode / noise can push a float ADU slightly negative, and
+            # astype would wrap it to a huge positive count
+            info = np.iinfo(self.dtype)
+            data = np.clip(data, info.min, info.max)
         return data.astype(self.dtype, copy=False), photon_energy
 
     def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:

@@ -230,3 +230,53 @@ class TestTrailingEosInSameBatch:
         assert sum(b.num_valid for b in batches) == 3
         leftover = q.get()
         assert isinstance(leftover, EndOfStream)  # survived for the sibling
+
+
+class TestUint16Stream:
+    """Detector-native uint16 ADUs end to end: half the transport and
+    host->device bytes of f32; calibration upcasts on device."""
+
+    def test_u16_stream_through_pipeline_and_calib(self):
+        import threading
+
+        import jax
+        import numpy as np
+
+        from psana_ray_tpu.config import RetrievalMode
+        from psana_ray_tpu.infeed import InfeedPipeline
+        from psana_ray_tpu.ops import fused_calibrate
+        from psana_ray_tpu.records import EndOfStream, FrameRecord
+        from psana_ray_tpu.sources import SyntheticSource
+        from psana_ray_tpu.transport import RingBuffer
+
+        n = 10
+        src = SyntheticSource(
+            num_events=n, detector_name="epix100", seed=0, dtype=np.uint16
+        )
+        ped = np.asarray(src.pedestal())
+        gain = np.asarray(src.gain_map())
+        mask = np.asarray(src.create_bad_pixel_mask())
+        q = RingBuffer(maxsize=16)
+
+        def produce():
+            for i in range(n):
+                data, e = src.event(i, RetrievalMode.RAW)
+                assert data.dtype == np.uint16
+                assert q.put_wait(FrameRecord(0, i, data, e), timeout=10)
+            assert q.put_wait(EndOfStream(total_events=n), timeout=10)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        pipe = InfeedPipeline(q, batch_size=4, poll_interval_s=0.001)
+        outs = []
+        seen = pipe.run(
+            lambda b: fused_calibrate(b.frames, ped, gain, mask, threshold=10.0),
+            on_result=lambda out, b: outs.append((out, b)),
+            block_until_ready=True,
+        )
+        t.join(timeout=10)
+        assert seen == n
+        for out, b in outs:
+            assert b.frames.dtype == np.uint16  # stream stays u16 to the device
+            assert out.dtype == np.float32  # calibration upcasts on device
+            assert bool(jax.numpy.isfinite(out).all())

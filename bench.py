@@ -120,7 +120,14 @@ def main():
     batch_size = 32
     n_pool = 64
     det = "epix10k2M"
-    extras = {"measurement": "device-clock (jax.profiler trace)"}
+    extras = {
+        "measurement": "device-clock (jax.profiler trace)",
+        "host_stream_note": (
+            "passthrough/e2e/fanin are host wall-clock through this "
+            "environment's shared tunnel host (H2D ~30 MB/s cold); they "
+            "measure the host pipeline, not the device — see PERF_NOTES.md"
+        ),
+    }
 
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
 

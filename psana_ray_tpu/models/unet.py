@@ -28,6 +28,16 @@ from psana_ray_tpu.models.resnet import _conv, _norm
 Dtype = Any
 
 
+def _upsample2x(x: jax.Array) -> jax.Array:
+    """2x nearest-neighbor upsample as broadcast+reshape. Identical output
+    to ``jax.image.resize(..., 'nearest')`` for exact 2x on even extents,
+    but without resize's per-pixel index arithmetic (~9 ms of
+    divide/multiply fusions per forward at epix10k2M scale)."""
+    n, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (n, h, 2, w, 2, c))
+    return x.reshape(n, 2 * h, 2 * w, c)
+
+
 class ConvBlock(nn.Module):
     features: int
     dtype: Dtype = jnp.bfloat16
@@ -88,8 +98,7 @@ class PeakNetUNet(nn.Module):
         x = ConvBlock(self.features[-1], dtype=self.dtype, norm=self.norm)(x)
         # decoder
         for f, skip in zip(reversed(self.features[:-1]), reversed(skips)):
-            n, h, w, c = skip.shape
-            x = jax.image.resize(x, (x.shape[0], h, w, x.shape[-1]), "nearest")
+            x = _upsample2x(x)
             x = _conv(f, (3, 3), (1, 1), self.dtype)(x)
             x = MergeBlock(f, dtype=self.dtype, norm=self.norm)(x, skip)
         # per-pixel logits in f32

@@ -158,3 +158,14 @@ class TestMergeBlockEquivalence:
         out = model.apply(v, x)
         assert out.shape == (2, 16, 16, 1)
         assert np.isfinite(np.asarray(out)).all()
+
+    def test_upsample2x_matches_resize_nearest(self, rng):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from psana_ray_tpu.models.unet import _upsample2x
+
+        x = jnp.asarray(rng.normal(size=(2, 5, 6, 3)).astype(np.float32))
+        ref = jax.image.resize(x, (2, 10, 12, 3), "nearest")
+        np.testing.assert_array_equal(np.asarray(_upsample2x(x)), np.asarray(ref))

@@ -14,6 +14,11 @@ from psana_ray_tpu.parallel.mesh import (  # noqa: F401
     local_batch_slice,
 )
 from psana_ray_tpu.parallel.sharding import ShardingRules, infer_sharding  # noqa: F401
+from psana_ray_tpu.parallel.flash import (  # noqa: F401
+    attention_with_stats,
+    flash_attention,
+    ring_flash_attention,
+)
 from psana_ray_tpu.parallel.ring_attention import (  # noqa: F401
     reference_attention,
     ring_attention,

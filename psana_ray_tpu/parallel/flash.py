@@ -1,0 +1,164 @@
+"""Flash attention (Pallas TPU kernel) + ring composition over the mesh.
+
+:func:`ring_attention` (ring_attention.py) is the exact XLA formulation —
+differentiable, runs anywhere, materializes one [Sq, Sk] score block per
+hop. This module is the serving-optimized TPU path:
+
+- :func:`attention_with_stats` — one device's attention returning the
+  online-softmax statistics (normalized output + row log-sum-exp). On TPU
+  with kernel-friendly shapes it runs the stock Pallas flash kernel
+  (``jax.experimental.pallas.ops.tpu.flash_attention``) so the score
+  matrix never leaves VMEM; elsewhere (or for odd shapes) an XLA fallback
+  computes the same statistics.
+- :func:`ring_flash_attention` — K/V shards rotate around the ``seq``
+  mesh axis (``lax.ppermute`` — neighbor ICI traffic only); each hop runs
+  a full flash attention against the visiting K/V block and hops combine
+  by log-sum-exp, which is exact (softmax is associative under LSE
+  renormalization). Causal hops use BLOCK-level structure: a visiting
+  block entirely in the future contributes nothing (skipped — no wasted
+  FLOPs), entirely in the past attends unmasked, and only the diagonal
+  block runs the masked kernel.
+
+Layouts match ring_attention.py: global ``[B, S, H, D]`` sharded
+``P(None, seq_axis)``. The flash kernel path is forward-only (the stock
+kernel's residual-returning entry point has no VJP); use
+:func:`ring_attention` for training.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _xla_attention_with_stats(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Array]:
+    """[B,H,Sq,D] x [B,H,Sk,D] -> (o [B,H,Sq,D], lse [B,H,Sq])."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((ki > qi)[None, None], NEG_INF, s)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v) / jnp.maximum(l, 1e-30)[..., None]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return o, lse
+
+
+def _kernel_shapes_ok(q, k) -> bool:
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    return d % 128 == 0 and sq % 128 == 0 and sk % 128 == 0
+
+
+def attention_with_stats(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """Attention + row log-sum-exp, ``[B, H, S, D]`` layout.
+
+    Dispatches to the Pallas TPU flash kernel when the backend and shapes
+    allow (D and both sequence lengths multiples of 128), else the XLA
+    formulation. Both return bit-compatible statistics for LSE combining.
+    """
+    if jax.default_backend() == "tpu" and _kernel_shapes_ok(q, k):
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+        block = 128
+        o, l, m = fa._flash_attention_impl(
+            q, k, v, None, None, True, causal, q.shape[-1] ** -0.5,
+            1, block, block, block, False,
+        )
+        return o, m + jnp.log(jnp.maximum(l, 1e-30))
+    return _xla_attention_with_stats(q, k, v, causal)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Single-device attention, repo layout ``[B, S, H, D]`` (the
+    long-sequence path when the whole context fits one chip)."""
+    o, _ = attention_with_stats(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        causal=causal,
+    )
+    return o.transpose(0, 2, 1, 3)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    causal: bool = False,
+) -> jax.Array:
+    """Exact ring attention with per-hop flash kernels + LSE combining.
+
+    q/k/v: global ``[B, S, H, D]`` sharded ``P(None, seq_axis)``. Under a
+    causal mask the hop whose K/V block lies entirely in this shard's
+    future is skipped outright (zero FLOPs), past blocks run unmasked, and
+    only the diagonal hop pays the masked kernel — the block-level
+    causal structure a token-level mask can't exploit.
+    """
+    n_ring = mesh.shape[seq_axis]
+    spec = P(None, seq_axis, None, None)
+
+    def local(q, k, v):
+        idx = lax.axis_index(seq_axis)
+        qh = q.transpose(0, 2, 1, 3)  # [B,H,Sq,D]
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        b, h, sq, d = qh.shape
+
+        mx = jnp.full((b, h, sq), NEG_INF, qh.dtype)
+        num = jnp.zeros_like(qh)
+        den = jnp.zeros((b, h, sq), qh.dtype)
+
+        def hop_outputs(k_cur, v_cur, src):
+            if not causal:
+                return attention_with_stats(qh, k_cur, v_cur, causal=False)
+
+            def skip(k_cur, v_cur):
+                return jnp.zeros_like(qh), jnp.full((b, h, sq), NEG_INF, qh.dtype)
+
+            def full(k_cur, v_cur):
+                return attention_with_stats(qh, k_cur, v_cur, causal=False)
+
+            def diag(k_cur, v_cur):
+                return attention_with_stats(qh, k_cur, v_cur, causal=True)
+
+            branch = (src < idx).astype(jnp.int32) + 2 * (src == idx).astype(jnp.int32)
+            return lax.switch(branch, (skip, full, diag), k_cur, v_cur)
+
+        def body(step, carry):
+            mx, num, den, k_cur, v_cur = carry
+            src = (idx - step) % n_ring
+            o_i, lse_i = hop_outputs(k_cur, v_cur, src)
+            m_new = jnp.maximum(mx, lse_i)
+            # guards: exp(NEG_INF - NEG_INF) = 1 would pollute the sums on
+            # skipped hops / before the first contributing hop
+            alpha = jnp.where(mx <= NEG_INF / 2, 0.0, jnp.exp(mx - m_new))
+            w = jnp.where(lse_i <= NEG_INF / 2, 0.0, jnp.exp(lse_i - m_new))
+            num = num * alpha[..., None] + o_i * w[..., None]
+            den = den * alpha + w
+            perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
+            k_nxt = lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = lax.ppermute(v_cur, seq_axis, perm)
+            return m_new, num, den, k_nxt, v_nxt
+
+        mx, num, den, _, _ = lax.fori_loop(0, n_ring, body, (mx, num, den, kh, vh))
+        o = num / jnp.maximum(den, 1e-30)[..., None]
+        return o.transpose(0, 2, 1, 3)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

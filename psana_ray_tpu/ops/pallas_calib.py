@@ -4,10 +4,13 @@ The XLA path (:func:`psana_ray_tpu.ops.calib.calibrate`) materializes the
 intermediate ``(raw - ped) / gain`` between the baseline reduction and its
 application. This kernel fuses reduce-and-apply per panel inside VMEM.
 
-Layout: panels are flattened to a ``[B*P, H, W]`` grid axis; each panel is
+Layout: panels are flattened to a ``[B*P, H, W]`` axis; each panel is
 processed in ``nt`` row-tiles over a two-phase inner grid —
 
-    grid = (B*P, 2, nt)   # phases: 0 = accumulate sum/count, 1 = apply
+    grid = (P, B, 2, nt)   # phases: 0 = accumulate sum/count, 1 = apply
+
+(panel-major so one panel's calibration constants keep their block index
+across all B frames and stream from HBM once per batch)
 
 with the running ``(sum, count)`` carried in SMEM scratch across grid steps
 (TPU grids execute sequentially, so scratch persists per panel). When a
@@ -55,6 +58,8 @@ def _pick_tile_rows(h: int, w: int, itemsize: int = 4) -> int:
 
 
 def _calib_kernel(raw_ref, ped_ref, gain_ref, mask_ref, out_ref, acc_ref, *, threshold: float):
+    # compute stays in the raw dtype (f32); only the final store narrows
+    # when out_dtype demotes (bf16 for model consumers halves the write)
     phase = pl.program_id(2)
     tile = pl.program_id(3)
     x = (raw_ref[0] - ped_ref[0]) / gain_ref[0]
@@ -70,15 +75,15 @@ def _calib_kernel(raw_ref, ped_ref, gain_ref, mask_ref, out_ref, acc_ref, *, thr
         bg = jnp.logical_and(jnp.abs(x) < threshold, good_pix)
         acc_ref[0] += jnp.sum(jnp.where(bg, x, jnp.zeros((), x.dtype)))
         acc_ref[1] += jnp.sum(bg.astype(x.dtype))
-        out_ref[0] = jnp.zeros_like(x)  # keep the output block defined
+        out_ref[0] = jnp.zeros_like(x).astype(out_ref.dtype)  # keep the output block defined
 
     @pl.when(phase == 1)
     def _apply():
         baseline = acc_ref[0] / jnp.maximum(acc_ref[1], 1.0)
-        out_ref[0] = jnp.where(good_pix, x - baseline, jnp.zeros((), x.dtype))
+        out_ref[0] = jnp.where(good_pix, x - baseline, jnp.zeros((), x.dtype)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("threshold", "interpret"))
+@functools.partial(jax.jit, static_argnames=("threshold", "interpret", "out_dtype"))
 def fused_calibrate(
     raw: jax.Array,
     pedestal: jax.Array,
@@ -86,6 +91,7 @@ def fused_calibrate(
     mask: jax.Array,
     threshold: float = 10.0,
     interpret: Optional[bool] = None,
+    out_dtype=None,
 ) -> jax.Array:
     """One-pass calibration: ``where(mask, (raw-ped)/gain - cm, 0)`` with the
     mean-algorithm common mode of :func:`calib.common_mode`.
@@ -135,7 +141,7 @@ def fused_calibrate(
             pl.BlockSpec((1, hb, w), panel_idx),
         ],
         out_specs=pl.BlockSpec((1, hb, w), frame_idx),
-        out_shape=jax.ShapeDtypeStruct((b * p, h, w), raw.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * p, h, w), out_dtype or raw.dtype),
         scratch_shapes=[pltpu.SMEM((2,), raw.dtype)],
         interpret=interpret,
     )(flat_raw, pedestal, gain, mask)

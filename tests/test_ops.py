@@ -141,3 +141,23 @@ def test_fused_unbatched(frame_set):
         jnp.asarray(fs["mask"]),
     )
     assert out.shape == fs["raw"][0].shape
+
+
+class TestCalibOutDtype:
+    def test_bf16_output_matches_f32_to_tolerance(self, rng):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from psana_ray_tpu.ops import fused_calibrate
+
+        p, h, w = 2, 64, 128
+        ped = rng.normal(1000, 5, size=(p, h, w)).astype(np.float32)
+        gain = (1 + 0.02 * rng.normal(size=(p, h, w))).astype(np.float32)
+        mask = (rng.random((p, h, w)) > 0.05).astype(np.uint8)
+        raw = (ped + 30 * rng.normal(size=(4, p, h, w))).astype(np.float32)
+        f32 = fused_calibrate(raw, ped, gain, mask, threshold=10.0)
+        b16 = fused_calibrate(raw, ped, gain, mask, threshold=10.0, out_dtype=jnp.bfloat16)
+        assert b16.dtype == jnp.bfloat16
+        scale = float(np.max(np.abs(np.asarray(f32)))) + 1e-6
+        err = np.max(np.abs(np.asarray(f32) - np.asarray(b16, np.float32))) / scale
+        assert err < 0.01  # bf16 rounding of the final store only

@@ -365,7 +365,7 @@ def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras):
     from psana_ray_tpu.sources import SyntheticSource
     from psana_ray_tpu.transport import RingBuffer
 
-    n_epix, n_jf = 32, 16
+    n_epix, n_jf = 16, 8
     jf_src = SyntheticSource(num_events=16, detector_name="jungfrau4M", seed=1)
     jf_pool = [jf_src.event(i, RetrievalMode.RAW)[0] for i in range(8)]
     jf_ped = jnp.asarray(jf_src.pedestal())

@@ -80,6 +80,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     loss_fn: Callable[..., jax.Array],
     donate: bool = True,
+    remat: bool = False,
 ):
     """Build ``(state, batch) -> (state, loss)``.
 
@@ -87,11 +88,18 @@ def make_train_step(
     axis happens inside jit via the sharding propagation (batch sharded on
     'data', params replicated/TP -> XLA inserts psum on the grads).
     ``donate=True`` donates the state buffers, so params update in place —
-    essential at ResNet-50 scale on a 16 GB chip."""
+    essential at ResNet-50 scale on a 16 GB chip. ``remat=True`` wraps the
+    forward in ``jax.checkpoint`` so the backward pass recomputes
+    activations instead of storing them — the FLOPs-for-HBM trade that
+    makes long-sequence / deep-model training fit on chip."""
 
     def _step(state: TrainState, x: jax.Array, batch_aux) -> Tuple[TrainState, jax.Array]:
+        apply = model.apply
+        if remat:
+            apply = jax.checkpoint(apply)
+
         def loss_of(variables):
-            logits = model.apply(variables, x)
+            logits = apply(variables, x)
             return loss_fn(logits, batch_aux)
 
         loss, grads = jax.value_and_grad(loss_of)(state.variables)

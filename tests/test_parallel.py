@@ -121,3 +121,36 @@ class TestShardedTraining:
         )
         state, loss = step(state, x, (targets, jnp.ones((8,))))
         assert np.isfinite(float(loss))
+
+
+def test_train_step_remat_matches_plain(mesh):
+    """jax.checkpoint must change memory, not math: one remat step equals
+    one plain step bit-for-bit given identical init."""
+    import numpy as np
+    import optax
+
+    from psana_ray_tpu.models import ResNet18, panels_to_nhwc
+    from psana_ray_tpu.models.losses import masked_softmax_xent
+    from psana_ray_tpu.parallel.steps import create_train_state, make_train_step
+
+    model = ResNet18(num_classes=2, width=16)
+    frames = jnp.asarray(
+        np.random.default_rng(0).normal(size=(8, 2, 16, 16)).astype(np.float32)
+    )
+    x = panels_to_nhwc(frames)
+    labels = jnp.asarray(np.arange(8) % 2)
+    valid = jnp.ones((8,), jnp.uint8)
+    opt = optax.sgd(1e-2)
+    loss_fn = lambda logits, aux: masked_softmax_xent(logits, aux[0], aux[1])  # noqa: E731
+
+    out = {}
+    for name, use_remat in (("plain", False), ("remat", True)):
+        state = create_train_state(model, opt, jax.random.key(0), x, mesh)
+        step = make_train_step(model, opt, loss_fn, donate=False, remat=use_remat)
+        state, loss = step(state, x, (labels, valid))
+        out[name] = (float(loss), state)
+    assert out["plain"][0] == out["remat"][0]
+    flat_p = jax.tree.leaves(out["plain"][1].variables)
+    flat_r = jax.tree.leaves(out["remat"][1].variables)
+    for a, b in zip(flat_p, flat_r):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

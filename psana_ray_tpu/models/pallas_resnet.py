@@ -84,8 +84,16 @@ def _col_mask(a: jax.Array, rows: int, cols_buf: int, cols_true: int, ch: int):
     return jnp.where(keep, a, jnp.zeros((), a.dtype))
 
 
+def _ypad_dims(h: int, wib: int, s: int):
+    """y1 pad-buffer extents. At stride 2 the buffer carries two extra
+    rows/cols so the 2x2 polyphase extraction (which reads rows a + 2*r,
+    r < h/2+2, a in {0,1}) stays in bounds."""
+    extra = 2 if s == 2 else 0
+    return h + s + 1 + extra, wib + s + 1 + extra
+
+
 def _bottleneck_kernel(
-    *refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr, cro, emit="full"
+    *refs, cin, f, cout, h, wi, wib, w_dma, stride, proj, cr, cro, cpp=1, emit="full"
 ):
     """See module docstring. Alignment note: sliced HBM<->VMEM DMAs require
     the last dim to be a multiple of 128 and the second-to-last a multiple
@@ -101,16 +109,19 @@ def _bottleneck_kernel(
     s = stride
     ho, wo = h // s, wi // s  # true output extents
     wo_buf = _up(wo, 8)
+    refs = list(refs)
+    sem = refs.pop()
+    pp_v = refs.pop() if s == 2 else None  # polyphase planes scratch
     if emit == "y2":
         (x_h, w1_h, w2_h, s1, b1, s2, b2, out_h,
-         x_v, w1_v, w2_v, y1p_v, out_v, sem) = refs
+         x_v, w1_v, w2_v, y1p_v, out_v) = refs
         w3_h = wp_h = w3_v = wp_v = s3 = b3 = sp = bp = None
     elif proj:
         (x_h, w1_h, w2_h, w3_h, wp_h, s1, b1, s2, b2, s3, b3, sp, bp, out_h,
-         x_v, w1_v, w2_v, w3_v, wp_v, y1p_v, out_v, sem) = refs
+         x_v, w1_v, w2_v, w3_v, wp_v, y1p_v, out_v) = refs
     else:
         (x_h, w1_h, w2_h, w3_h, s1, b1, s2, b2, s3, b3, out_h,
-         x_v, w1_v, w2_v, w3_v, y1p_v, out_v, sem) = refs
+         x_v, w1_v, w2_v, w3_v, y1p_v, out_v) = refs
         wp_h = wp_v = sp = bp = None
 
     b = pl.program_id(0)
@@ -146,7 +157,8 @@ def _bottleneck_kernel(
     # dynamic chunk offsets index the LEADING (row) dim of 3D VMEM refs —
     # untiled, so no sublane/lane alignment constraint applies.
     off = 0 if s == 1 else 1
-    y1p_v[:] = jnp.zeros((h + s + 1, wib + s + 1, f), _BF16)
+    ypr, ypc = _ypad_dims(h, wib, s)
+    y1p_v[:] = jnp.zeros((ypr, ypc, f), _BF16)
 
     def _y1_body(i, carry):
         r0 = i * cr
@@ -163,6 +175,26 @@ def _bottleneck_kernel(
 
     jax.lax.fori_loop(0, h // cr, _y1_body, 0, unroll=False)
 
+    if s == 2:
+        # 2x2 polyphase split of the pad buffer: pp[a, c][r, q] =
+        # y1p[2r + a, 2q + c]. Built ONCE (4 strided extractions); every
+        # strided tap then reads a PLAIN slice of its phase plane instead
+        # of re-running the reshape-mask-sum downsample per tap (10x per
+        # block: measured 2x on the stride-2 projection blocks).
+        hp2, wp2 = h // 2 + 2, wib // 2 + 2
+
+        def _pp_body(i, carry):
+            # all four phases inside ONE loop body: separate per-phase
+            # loops would each be charged their own scoped-vmem stack
+            r0 = i * cpp
+            for a in (0, 1):
+                for c in (0, 1):
+                    raw = y1p_v[pl.ds(a + 2 * r0, 2 * cpp), c:c + 2 * wp2]
+                    pp_v[a, c, pl.ds(r0, cpp)] = _downsample(raw, 2, cpp, wp2, f)
+            return carry
+
+        jax.lax.fori_loop(0, hp2 // cpp, _pp_body, 0, unroll=False)
+
     # conv3x3(stride) + affine + silu, conv1x1 + affine, residual, silu —
     # chunked over output rows to bound the f32 accumulators
     def _out_body(i, carry):
@@ -170,9 +202,12 @@ def _bottleneck_kernel(
         acc2 = jnp.zeros((cro * wo_buf, f), jnp.float32)
         for t in range(9):
             dy, dx = divmod(t, 3)
-            c0 = dx + off
-            raw = y1p_v[pl.ds(s * ro + dy + off, s * cro), c0:c0 + s * wo_buf]
-            patch = _downsample(raw, s, cro, wo_buf, f)
+            if s == 1:
+                patch = y1p_v[pl.ds(ro + dy, cro), dx:dx + wo_buf]
+            else:
+                ar, radd = (dy + off) % 2, (dy + off) // 2
+                ac, cadd = (dx + off) % 2, (dx + off) // 2
+                patch = pp_v[ar, ac, pl.ds(ro + radd, cro), cadd:cadd + wo_buf]
             acc2 += jnp.dot(
                 patch.reshape(cro * wo_buf, f), w2_v[t],
                 preferred_element_type=jnp.float32,
@@ -326,9 +361,13 @@ def fused_bottleneck(
     if proj:
         wp = _pad_to(wp, 0, cin)
 
+    ypr, ypc = _ypad_dims(h, wib, s)
+    hp2, wp2 = h // 2 + 2, wib // 2 + 2  # polyphase plane extents (s == 2)
+    pp_bytes = 4 * hp2 * wp2 * f * 2 if s == 2 else 0
     fixed = (
         h * wib * cin * 2
-        + (h + s + 1) * (wib + s + 1) * f * 2
+        + ypr * ypc * f * 2
+        + pp_bytes
         + ho * wo_buf * cout * 2
         + w1.size * 2 + w2.size * 2 + w3.size * 2
         + (wp.size * 2 if proj else 0)
@@ -340,6 +379,8 @@ def fused_bottleneck(
     budget = max(256 * 1024, _VMEM_BUDGET - fixed)
     cr = _pick_chunk(h, wib * f * 8, budget)
     cro = _pick_chunk(ho, wo_buf * (8 * f + 10 * cout), budget)
+    # x4: all four polyphase extractions run in one loop body
+    cpp = _pick_chunk(hp2, wp2 * f * 48, budget) if s == 2 else 1
 
     any_spec = pl.BlockSpec(memory_space=pl.ANY)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
@@ -351,22 +392,25 @@ def fused_bottleneck(
         front = functools.partial(
             _bottleneck_kernel,
             cin=cin, f=f, cout=cout, h=h, wi=wi, wib=wib, w_dma=w_dma,
-            stride=s, proj=proj, cr=cr, cro=cro, emit="y2",
+            stride=s, proj=proj, cr=cr, cro=cro, cpp=cpp, emit="y2",
         )
+        front_scratch = [
+            pltpu.VMEM((h, wib, cin), _BF16),
+            pltpu.VMEM(w1.shape, _BF16),
+            pltpu.VMEM(w2.shape, _BF16),
+            pltpu.VMEM((ypr, ypc, f), _BF16),
+            pltpu.VMEM((ho, wo_buf, f), _BF16),
+        ]
+        if s == 2:
+            front_scratch.append(pltpu.VMEM((2, 2, hp2, wp2, f), _BF16))
+        front_scratch.append(pltpu.SemaphoreType.DMA)
         y2 = pl.pallas_call(
             front,
             grid=(bsz,),
             in_specs=[any_spec] * 3 + [vmem] * 4,
             out_specs=any_spec,
             out_shape=jax.ShapeDtypeStruct((bsz, ho, wo_buf, f), _BF16),
-            scratch_shapes=[
-                pltpu.VMEM((h, wib, cin), _BF16),
-                pltpu.VMEM(w1.shape, _BF16),
-                pltpu.VMEM(w2.shape, _BF16),
-                pltpu.VMEM((h + s + 1, wib + s + 1, f), _BF16),
-                pltpu.VMEM((ho, wo_buf, f), _BF16),
-                pltpu.SemaphoreType.DMA,
-            ],
+            scratch_shapes=front_scratch,
             interpret=interpret,
         )(x, w1, w2, s1, b1, s2, b2)
 
@@ -410,7 +454,7 @@ def fused_bottleneck(
     kernel = functools.partial(
         _bottleneck_kernel,
         cin=cin, f=f, cout=cout, h=h, wi=wi, wib=wib, w_dma=w_dma,
-        stride=s, proj=proj, cr=cr, cro=cro,
+        stride=s, proj=proj, cr=cr, cro=cro, cpp=cpp,
     )
     n_aff = 8 if proj else 6
     in_specs = [any_spec] * (5 if proj else 4) + [vmem] * n_aff
@@ -426,10 +470,12 @@ def fused_bottleneck(
     if proj:
         scratch.append(pltpu.VMEM(wp.shape, _BF16))
     scratch += [
-        pltpu.VMEM((h + s + 1, wib + s + 1, f), _BF16),
+        pltpu.VMEM((ypr, ypc, f), _BF16),
         pltpu.VMEM((ho, wo_buf, cout), _BF16),
-        pltpu.SemaphoreType.DMA,
     ]
+    if s == 2:
+        scratch.append(pltpu.VMEM((2, 2, hp2, wp2, f), _BF16))
+    scratch.append(pltpu.SemaphoreType.DMA)
 
     return pl.pallas_call(
         kernel,

@@ -31,6 +31,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import signal
 import sys
 import tempfile
 import threading
@@ -40,9 +41,100 @@ import numpy as np
 
 PER_CHIP_TARGET_FPS = 10_000 / 16  # v5e-16 north star, per chip
 
+# Artifact-survival budgets (seconds). The driver kills the whole bench at
+# some unknown timeout (round 2 died at rc=124 with zero parseable output);
+# our own watchdog must always fire first, emit the current JSON, and exit 0.
+GLOBAL_BUDGET_S = float(os.environ.get("BENCH_GLOBAL_BUDGET_S", "480"))
+HEADLINE_BUDGET_S = float(os.environ.get("BENCH_HEADLINE_BUDGET_S", "180"))
+SECTION_BUDGET_S = float(os.environ.get("BENCH_SECTION_BUDGET_S", "150"))
+
 
 def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Indestructible-artifact machinery.  The final JSON line is held in _FINAL
+# and (re)printed after the headline and after every diagnostic section; the
+# driver takes the LAST parseable line, so each emit supersedes the previous
+# with strictly more data.  A watchdog thread enforces per-section + global
+# deadlines with os._exit(0) — a raw syscall that works even when the main
+# thread is wedged inside a C extension (the round-2 failure mode: the TPU
+# tunnel went UNAVAILABLE and a diagnostic hung until the driver's kill).
+# ---------------------------------------------------------------------------
+
+_FINAL = {
+    "metric": "epix10k2M frames/sec/chip (fused calibration)",
+    "value": 0.0,
+    "unit": "frames/s",
+    "vs_baseline": 0.0,
+}
+
+
+def emit_final():
+    # single unbuffered os.write, NO lock: this is called from the main
+    # thread, the watchdog thread, and the SIGTERM handler (which runs on
+    # the main thread and would self-deadlock on any non-reentrant lock
+    # the interrupted emit already holds). Lines are < PIPE_BUF, so the
+    # write is atomic on pipes.
+    os.write(1, (json.dumps(_FINAL) + "\n").encode())
+
+
+class Watchdog:
+    """Per-section + global deadline enforcement from a daemon thread."""
+
+    def __init__(self):
+        self._deadline = None
+        self._section = None
+        self._global_deadline = time.monotonic() + GLOBAL_BUDGET_S
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            time.sleep(0.5)
+            now = time.monotonic()
+            over_section = self._deadline is not None and now > self._deadline
+            over_global = now > self._global_deadline
+            if over_section or over_global:
+                which = (
+                    f"section {self._section!r}" if over_section else "global budget"
+                )
+                log(f"WATCHDOG: {which} exceeded — emitting final JSON and exiting")
+                _FINAL["watchdog_fired"] = self._section or "global"
+                emit_final()
+                os._exit(0)
+
+    def enter(self, name: str, budget_s: float):
+        self._section = name
+        self._deadline = time.monotonic() + budget_s
+
+    def leave(self):
+        self._deadline = None
+        self._section = None
+
+
+def _is_backend_unavailable(e: BaseException) -> bool:
+    s = repr(e)
+    return "UNAVAILABLE" in s or ("backend" in s.lower() and "setup" in s.lower())
+
+
+def run_section(wd: Watchdog, name: str, fn, budget_s: float = SECTION_BUDGET_S):
+    """Run one diagnostic under the watchdog; failures never sink the
+    artifact.  Returns True if the backend died (callers skip further
+    device sections fast instead of timing out one by one)."""
+    wd.enter(name, budget_s)
+    backend_dead = False
+    try:
+        fn()
+    except Exception as e:
+        log(f"{name} diagnostic skipped: {e!r}")
+        if _is_backend_unavailable(e):
+            _FINAL["backend_degraded"] = True
+            backend_dead = True
+    finally:
+        wd.leave()
+    emit_final()
+    return backend_dead
 
 
 def _parse_device_ms(trace_dir: str):
@@ -106,7 +198,30 @@ def device_time_ms(jax, fn, warm_args, fresh_args, label: str, extras=None):
 
 
 def main():
+    # emit whatever we have if the driver TERMs us before our own watchdog
+    # fires (only helps when the main thread is in Python, but free)
+    signal.signal(
+        signal.SIGTERM, lambda *_: (emit_final(), os._exit(0))
+    )
+    wd = Watchdog()
+
+    # _FINAL doubles as the extras dict: every key lands in the artifact
+    extras = _FINAL
+    extras["measurement"] = "device-clock (jax.profiler trace)"
+    extras["host_stream_note"] = (
+        "passthrough/e2e/fanin are host wall-clock through this "
+        "environment's shared tunnel host (H2D ~30 MB/s cold); they "
+        "measure the host pipeline, not the device — see PERF_NOTES.md"
+    )
+
+    wd.enter("jax-init", HEADLINE_BUDGET_S)
     import jax
+
+    # the axon TPU plugin ignores the JAX_PLATFORMS env var but honors the
+    # config knob — mirror it so `JAX_PLATFORMS=cpu python bench.py` really
+    # runs on CPU (used to validate the artifact machinery off-TPU)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     # persistent compile cache: the driver re-runs bench every round; only
     # the first run pays the (remote) XLA compile
@@ -120,14 +235,13 @@ def main():
     batch_size = 32
     n_pool = 64
     det = "epix10k2M"
-    extras = {
-        "measurement": "device-clock (jax.profiler trace)",
-        "host_stream_note": (
-            "passthrough/e2e/fanin are host wall-clock through this "
-            "environment's shared tunnel host (H2D ~30 MB/s cold); they "
-            "measure the host pipeline, not the device — see PERF_NOTES.md"
-        ),
-    }
+    # BENCH_SMOKE=1: tiny geometry so the FULL artifact path (headline ->
+    # diagnostics -> repeated emits) can be validated off-TPU in seconds;
+    # numbers produced this way are meaningless and flagged as such
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        det = "smoke_a"
+        _FINAL["smoke_mode"] = True
 
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
 
@@ -151,61 +265,93 @@ def main():
         lambda f: fused_calibrate(f, pedestal, gain, mask, threshold=10.0)
     )
 
-    # two DISTINCT device-resident raw batches: one warms the compile, the
-    # other is the traced dispatch (same-args would be tunnel-elided)
-    x_warm = jax.device_put(np.stack(pool[:batch_size]))
-    x_fresh = jax.device_put(np.stack(pool[batch_size : 2 * batch_size]))
-    jax.block_until_ready((x_warm, x_fresh))
-
     # ---------------- headline: device-resident fused calibration --------
-    ms = device_time_ms(jax, calib, (x_warm,), (x_fresh,), "fused calibration", extras)
-    calib_fps = batch_size / (ms / 1e3)
-    extras["calib_ms_per_frame"] = round(ms / batch_size, 4)
-    log(
-        f"fused calibration: {ms:.2f} ms / {batch_size} frames device-time "
-        f"-> {calib_fps:.0f} fps, {ms/batch_size:.3f} ms/frame"
-    )
+    # Measured FIRST and emitted IMMEDIATELY — diagnostics below can only
+    # add keys to the artifact, never destroy it.  On an UNAVAILABLE
+    # backend, retry once, then emit a degraded headline instead of dying.
+    def measure_headline():
+        x_warm = jax.device_put(np.stack(pool[:batch_size]))
+        x_fresh = jax.device_put(np.stack(pool[batch_size : 2 * batch_size]))
+        jax.block_until_ready((x_warm, x_fresh))
+        ms = device_time_ms(
+            jax, calib, (x_warm,), (x_fresh,), "fused calibration", extras
+        )
+        return ms, x_warm, x_fresh
+
+    x_warm = x_fresh = None
+    for attempt in (1, 2):
+        wd.enter("headline-calibration", HEADLINE_BUDGET_S)
+        try:
+            ms, x_warm, x_fresh = measure_headline()
+            calib_fps = batch_size / (ms / 1e3)
+            extras["value"] = round(calib_fps, 1)
+            extras["vs_baseline"] = round(calib_fps / PER_CHIP_TARGET_FPS, 3)
+            extras["calib_ms_per_frame"] = round(ms / batch_size, 4)
+            log(
+                f"fused calibration: {ms:.2f} ms / {batch_size} frames "
+                f"device-time -> {calib_fps:.0f} fps, "
+                f"{ms/batch_size:.3f} ms/frame"
+            )
+            break
+        except Exception as e:
+            log(f"headline attempt {attempt} failed: {e!r}")
+            extras["headline_error"] = repr(e)[:300]
+            if not _is_backend_unavailable(e):
+                # a code bug, not infra: don't blame the backend, and let
+                # the independent sections (which compile their own
+                # kernels) still try to run
+                break
+            if attempt == 2:
+                extras["backend_degraded"] = True
+                break
+            time.sleep(5.0)
+        finally:
+            wd.leave()
+    emit_final()
+
+    backend_dead = extras.get("backend_degraded", False)
 
     # ---------------- config 1+2: e2e streaming over the shm ring --------
-    try:
-        transport, e2e = _bench_e2e_streaming(jax, calib, pool, batch_size, extras)
-        log(
-            f"e2e streaming [{transport}] (transport+batcher+prefetch+calib): "
-            f"{e2e:.0f} fps wall-clock (tunnel-bandwidth-bound here; see "
-            f"PERF_NOTES.md)"
+    # host-pipeline section: runs even with a degraded device backend only
+    # if the headline succeeded (it needs the compiled calib step)
+    if not backend_dead:
+        backend_dead |= run_section(
+            wd,
+            "e2e-streaming",
+            lambda: _bench_e2e_streaming(jax, calib, pool, batch_size, extras),
         )
-    except Exception as e:  # diagnostics must not sink the headline
-        log(f"e2e streaming diagnostic skipped: {e!r}")
 
     # ---------------- config 4: fused Pallas ResNet-50 -------------------
-    try:
-        _bench_resnet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, batch_size, extras)
-    except Exception as e:
-        log(f"ResNet-50 diagnostic skipped: {e!r}")
+    if not backend_dead and x_warm is not None:
+        backend_dead |= run_section(
+            wd,
+            "resnet50",
+            lambda: _bench_resnet(
+                jax, jnp, pedestal, gain, mask, x_warm, x_fresh, batch_size, extras
+            ),
+        )
 
     # ---------------- config 3: U-Net segmentation + peak extraction -----
-    try:
-        _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, extras)
-    except Exception as e:
-        log(f"U-Net diagnostic skipped: {e!r}")
+    if not backend_dead and x_warm is not None:
+        backend_dead |= run_section(
+            wd,
+            "unet",
+            lambda: _bench_unet(
+                jax, jnp, pedestal, gain, mask, x_warm, x_fresh, extras
+            ),
+        )
 
     # ---------------- config 5: multi-detector fan-in --------------------
-    try:
-        _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras)
-    except Exception as e:
-        log(f"fan-in diagnostic skipped: {e!r}")
-
-    print(
-        json.dumps(
-            {
-                "metric": "epix10k2M frames/sec/chip (fused calibration)",
-                "value": round(calib_fps, 1),
-                "unit": "frames/s",
-                "vs_baseline": round(calib_fps / PER_CHIP_TARGET_FPS, 3),
-                **extras,
-            }
+    if not backend_dead:
+        run_section(
+            wd,
+            "fanin",
+            lambda: _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras, smoke),
         )
-    )
+    if backend_dead:
+        log("backend degraded — remaining device diagnostics skipped fast")
+
+    emit_final()
 
 
 def _bench_e2e_streaming(jax, calib, pool, batch_size, extras):
@@ -272,6 +418,11 @@ def _bench_e2e_streaming(jax, calib, pool, batch_size, extras):
     if use_shm:
         q2.destroy()
     lat = pipe.metrics.step_latency.summary_ms()
+    log(
+        f"e2e streaming [{transport}] (transport+batcher+prefetch+calib): "
+        f"{e2e_fps:.0f} fps wall-clock (tunnel-bandwidth-bound here; see "
+        f"PERF_NOTES.md)"
+    )
     extras["e2e_fps"] = round(e2e_fps, 1)
     extras["p50_ms"] = round(lat["p50_ms"] / batch_size, 3)  # per frame, amortized
     extras["p50_batch_ms"] = round(lat["p50_ms"], 2)
@@ -354,7 +505,7 @@ def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, extras):
     )
 
 
-def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras):
+def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras, smoke=False):
     """Config 5: epix10k2M + jungfrau4M fan-in through one consumer loop
     with per-detector compiled calibration steps (wall-clock — measures
     the host merge pipeline end to end)."""
@@ -365,8 +516,9 @@ def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras):
     from psana_ray_tpu.sources import SyntheticSource
     from psana_ray_tpu.transport import RingBuffer
 
+    jf_det = "smoke_b" if smoke else "jungfrau4M"
     n_epix, n_jf = 16, 8
-    jf_src = SyntheticSource(num_events=16, detector_name="jungfrau4M", seed=1)
+    jf_src = SyntheticSource(num_events=16, detector_name=jf_det, seed=1)
     jf_pool = [jf_src.event(i, RetrievalMode.RAW)[0] for i in range(8)]
     jf_ped = jnp.asarray(jf_src.pedestal())
     jf_gain = jnp.asarray(jf_src.gain_map())

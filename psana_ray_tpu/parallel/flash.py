@@ -6,10 +6,10 @@ hop. This module is the serving-optimized TPU path:
 
 - :func:`attention_with_stats` — one device's attention returning the
   online-softmax statistics (normalized output + row log-sum-exp). On TPU
-  with kernel-friendly shapes it runs the stock Pallas flash kernel
-  (``jax.experimental.pallas.ops.tpu.flash_attention``) so the score
-  matrix never leaves VMEM; elsewhere (or for odd shapes) an XLA fallback
-  computes the same statistics.
+  with kernel-friendly shapes it runs a vendored Pallas flash kernel
+  (below — no private JAX APIs) so the score matrix never leaves VMEM;
+  elsewhere (or for odd shapes) an XLA fallback computes the same
+  statistics.
 - :func:`ring_flash_attention` — K/V shards rotate around the ``seq``
   mesh axis (``lax.ppermute`` — neighbor ICI traffic only); each hop runs
   a full flash attention against the visiting K/V block and hops combine
@@ -19,29 +19,40 @@ hop. This module is the serving-optimized TPU path:
   FLOPs), entirely in the past attends unmasked, and only the diagonal
   block runs the masked kernel.
 
+Dtype contract: ``o`` matches the query dtype; the log-sum-exp statistics
+are ALWAYS float32 regardless of input dtype (bf16 stats lose peaks and
+break cross-hop renormalization), and the ring's running (m, num, den)
+carry is float32 for the same reason.
+
 Layouts match ring_attention.py: global ``[B, S, H, D]`` sharded
-``P(None, seq_axis)``. The flash kernel path is forward-only (the stock
-kernel's residual-returning entry point has no VJP); use
+``P(None, seq_axis)``. This path is forward-only — reverse-mode AD raises
+immediately (custom_vjp with an erroring backward); use
 :func:`ring_attention` for training.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+import functools
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
+from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
+_BLOCK_Q = 128
+_BLOCK_K = 128
 
 
 def _xla_attention_with_stats(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Array]:
-    """[B,H,Sq,D] x [B,H,Sk,D] -> (o [B,H,Sq,D], lse [B,H,Sq])."""
+    """[B,H,Sq,D] x [B,H,Sk,D] -> (o [B,H,Sq,D] q.dtype, lse [B,H,Sq] f32)."""
     scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
         qi = jnp.arange(q.shape[2])[:, None]
         ki = jnp.arange(k.shape[2])[None, :]
@@ -49,36 +60,160 @@ def _xla_attention_with_stats(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Arr
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v) / jnp.maximum(l, 1e-30)[..., None]
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ) / jnp.maximum(l, 1e-30)[..., None]
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
-    return o, lse
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# Vendored Pallas TPU flash kernel (public pallas APIs only).
+#
+# Grid (BH, Sq/block_q, Sk/block_k), key blocks iterating fastest: per step
+# ONE [block_q, d] query tile and ONE [block_k, d] K/V tile are resident in
+# VMEM (Pallas pipelines the tile DMAs across grid steps), so VMEM use is
+# independent of sequence length — a [block_q, Sk] score matrix never
+# exists and neither does a full K/V copy.  The online-softmax state
+# (m, l, acc) lives in f32 VMEM scratch, which persists across grid steps;
+# it is reset when a new query tile begins (kb == 0) and the normalized
+# output + lse are written on the tile's last key step.  Scores/stats are
+# f32; the p @ v matmul runs in the value dtype on the MXU with f32
+# accumulation.  Causal tiles mask with NEG_INF; the masked-out entries
+# are explicitly zeroed in p (exp(NEG_INF - NEG_INF) would otherwise
+# contribute 1 on fully-dead tiles).
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, sm_scale, causal, n_kb
+):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+
+    @pl.when(kb == 0)
+    def _reset():
+        m_ref[:] = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    s = (
+        jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * sm_scale
+    )  # [block_q, block_k]
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(cols > rows, NEG_INF, s)
+
+    m = m_ref[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # masked scores are exactly NEG_INF; on a fully-dead tile m_new stays
+    # NEG_INF and exp(s - m_new) would be exp(0) = 1 — zero them explicitly
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+    alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_new
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+
+def _pallas_attention_with_stats(
+    q, k, v, causal: bool, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """Vendored flash kernel entry. [B,H,S,D] layout, S/D multiples of 128."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qf = q.reshape(bh, sq, d)
+    kf = k.reshape(bh, sk, d)
+    vf = v.reshape(bh, sk, d)
+    n_kb = sk // _BLOCK_K
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=d**-0.5, causal=causal, n_kb=n_kb
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, sq // _BLOCK_Q, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK_Q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, _BLOCK_K, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, _BLOCK_K, d), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK_Q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, _BLOCK_Q), lambda i, j, kb: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK_Q, 1), jnp.float32),
+            pltpu.VMEM((_BLOCK_Q, 1), jnp.float32),
+            pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
 
 
 def _kernel_shapes_ok(q, k) -> bool:
-    b, h, sq, d = q.shape
+    sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
-    return d % 128 == 0 and sq % 128 == 0 and sk % 128 == 0
+    return d % 128 == 0 and sq % _BLOCK_Q == 0 and sk % _BLOCK_K == 0
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def attention_with_stats(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
 ) -> Tuple[jax.Array, jax.Array]:
     """Attention + row log-sum-exp, ``[B, H, S, D]`` layout.
 
-    Dispatches to the Pallas TPU flash kernel when the backend and shapes
-    allow (D and both sequence lengths multiples of 128), else the XLA
-    formulation. Both return bit-compatible statistics for LSE combining.
+    Dispatches to the vendored Pallas flash kernel when the backend and
+    shapes allow (D and both sequence lengths multiples of 128), else the
+    XLA formulation. Both return ``o`` in the query dtype and ``lse`` in
+    float32 — the statistics two hops combine must never be bf16.
     """
     if jax.default_backend() == "tpu" and _kernel_shapes_ok(q, k):
-        from jax.experimental.pallas.ops.tpu import flash_attention as fa
-
-        block = 128
-        o, l, m = fa._flash_attention_impl(
-            q, k, v, None, None, True, causal, q.shape[-1] ** -0.5,
-            1, block, block, block, False,
-        )
-        return o, m + jnp.log(jnp.maximum(l, 1e-30))
+        return _pallas_attention_with_stats(q, k, v, causal)
     return _xla_attention_with_stats(q, k, v, causal)
+
+
+def _aws_fwd(causal, q, k, v):
+    raise NotImplementedError(
+        "attention_with_stats / ring_flash_attention are forward-only "
+        "serving paths; use parallel.ring_attention for training."
+    )
+
+
+def _aws_bwd(causal, res, g):  # pragma: no cover - fwd already raises
+    raise NotImplementedError
+
+
+attention_with_stats.defvjp(_aws_fwd, _aws_bwd)
 
 
 def flash_attention(
@@ -119,16 +254,22 @@ def ring_flash_attention(
         vh = v.transpose(0, 2, 1, 3)
         b, h, sq, d = qh.shape
 
-        mx = jnp.full((b, h, sq), NEG_INF, qh.dtype)
-        num = jnp.zeros_like(qh)
-        den = jnp.zeros((b, h, sq), qh.dtype)
+        # running stats in f32 ALWAYS (see module docstring): both kernel
+        # and fallback emit f32 lse, and the hop-combine arithmetic below
+        # must not round peaks through bf16
+        mx = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+        num = jnp.zeros((b, h, sq, d), jnp.float32)
+        den = jnp.zeros((b, h, sq), jnp.float32)
 
         def hop_outputs(k_cur, v_cur, src):
             if not causal:
                 return attention_with_stats(qh, k_cur, v_cur, causal=False)
 
             def skip(k_cur, v_cur):
-                return jnp.zeros_like(qh), jnp.full((b, h, sq), NEG_INF, qh.dtype)
+                return (
+                    jnp.zeros_like(qh),
+                    jnp.full((b, h, sq), NEG_INF, jnp.float32),
+                )
 
             def full(k_cur, v_cur):
                 return attention_with_stats(qh, k_cur, v_cur, causal=False)
@@ -148,7 +289,7 @@ def ring_flash_attention(
             # skipped hops / before the first contributing hop
             alpha = jnp.where(mx <= NEG_INF / 2, 0.0, jnp.exp(mx - m_new))
             w = jnp.where(lse_i <= NEG_INF / 2, 0.0, jnp.exp(lse_i - m_new))
-            num = num * alpha[..., None] + o_i * w[..., None]
+            num = num * alpha[..., None] + o_i.astype(jnp.float32) * w[..., None]
             den = den * alpha + w
             perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
             k_nxt = lax.ppermute(k_cur, seq_axis, perm)
@@ -156,7 +297,7 @@ def ring_flash_attention(
             return m_new, num, den, k_nxt, v_nxt
 
         mx, num, den, _, _ = lax.fori_loop(0, n_ring, body, (mx, num, den, kh, vh))
-        o = num / jnp.maximum(den, 1e-30)[..., None]
+        o = (num / jnp.maximum(den, 1e-30)[..., None]).astype(q.dtype)
         return o.transpose(0, 2, 1, 3)
 
     return shard_map(

@@ -136,3 +136,80 @@ class TestRingFlashAttention:
             np.asarray(reference_attention(q, k, v, causal=True)),
             rtol=2e-5, atol=2e-5,
         )
+
+    def test_bf16_ring_matches_oracle(self, rng, seq_mesh):
+        """bf16 q/k/v through the ring: the f32 stats carry must keep the
+        lax.switch branches dtype-stable (round-2 ADVICE: the kernel path
+        emitted f32 lse while the causal skip branch returned bf16)."""
+        from psana_ray_tpu.parallel import ring_flash_attention
+        from psana_ray_tpu.parallel.ring_attention import reference_attention
+
+        b, s, h, d = 2, 32, 4, 8
+        mk = lambda: jnp.asarray(
+            rng.normal(size=(b, s, h, d)).astype(np.float32)
+        ).astype(jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        got = ring_flash_attention(q, k, v, seq_mesh, causal=True)
+        assert got.dtype == jnp.bfloat16
+        ref = reference_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            causal=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(ref), rtol=0.0, atol=3e-2
+        )
+
+
+class TestVendoredFlashKernel:
+    """Interpret-mode equivalence of the vendored Pallas flash kernel
+    (parallel/flash.py — replaces round 2's private
+    ``fa._flash_attention_impl`` dependency) against the XLA statistics
+    formulation, on the dtypes the serving path actually uses."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_kernel_matches_xla_stats(self, rng, causal, dtype):
+        from psana_ray_tpu.parallel.flash import (
+            _pallas_attention_with_stats,
+            _xla_attention_with_stats,
+        )
+
+        b, h, s, d = 2, 3, 256, 128
+        mk = lambda: jnp.asarray(
+            rng.normal(size=(b, h, s, d)).astype(np.float32) * 0.3
+        ).astype(dtype)
+        q, k, v = mk(), mk(), mk()
+        o_ref, lse_ref = _xla_attention_with_stats(q, k, v, causal)
+        o_pl, lse_pl = _pallas_attention_with_stats(q, k, v, causal, interpret=True)
+        assert o_pl.dtype == dtype
+        assert lse_pl.dtype == jnp.float32 and lse_ref.dtype == jnp.float32
+        tol = 2e-2 if dtype == jnp.bfloat16 else 2e-5
+        np.testing.assert_allclose(
+            np.asarray(o_pl, dtype=np.float32),
+            np.asarray(o_ref, dtype=np.float32),
+            rtol=0.0, atol=tol,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse_pl), np.asarray(lse_ref), rtol=0.0, atol=1e-2
+        )
+
+    def test_uneven_kv_length(self, rng):
+        from psana_ray_tpu.parallel.flash import (
+            _pallas_attention_with_stats,
+            _xla_attention_with_stats,
+        )
+
+        q = jnp.asarray(rng.normal(size=(1, 2, 128, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, 384, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, 384, 128)).astype(np.float32))
+        o_ref, lse_ref = _xla_attention_with_stats(q, k, v, False)
+        o_pl, lse_pl = _pallas_attention_with_stats(q, k, v, False, interpret=True)
+        np.testing.assert_allclose(np.asarray(o_pl), np.asarray(o_ref), atol=3e-5)
+        np.testing.assert_allclose(np.asarray(lse_pl), np.asarray(lse_ref), atol=1e-3)
+
+    def test_forward_only_raises_on_grad(self, rng):
+        from psana_ray_tpu.parallel.flash import attention_with_stats
+
+        q = jnp.asarray(rng.normal(size=(1, 1, 8, 8)).astype(np.float32))
+        with pytest.raises(NotImplementedError, match="forward-only"):
+            jax.grad(lambda q: attention_with_stats(q, q, q)[0].sum())(q)

@@ -22,7 +22,7 @@ Package layout (reference parity noted per module; see SURVEY.md):
 - :mod:`psana_ray_tpu.producer`  — producer entry point (reference producer.py)
 """
 
-__version__ = "0.1.0"
+__version__ = "26.7.29"  # keep in sync with pyproject.toml
 
 from psana_ray_tpu.records import EndOfStream, FrameRecord  # noqa: F401
 from psana_ray_tpu.config import PipelineConfig  # noqa: F401

@@ -12,7 +12,8 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, Optional
 
 
 class Meter:
@@ -23,7 +24,7 @@ class Meter:
         self._lock = threading.Lock()
         self._count = 0
         self._t0 = time.monotonic()
-        self._window: List[tuple] = []  # (t, cumulative)
+        self._window: deque = deque()  # (t, cumulative)
 
     def add(self, n: int = 1):
         with self._lock:
@@ -32,7 +33,7 @@ class Meter:
             self._window.append((now, self._count))
             cutoff = now - 10.0
             while self._window and self._window[0][0] < cutoff:
-                self._window.pop(0)
+                self._window.popleft()
 
     @property
     def count(self) -> int:

@@ -19,7 +19,7 @@ import numpy as np
 
 from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord
 from psana_ray_tpu.transport.recovery import return_to_queue
-from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 
 
 @dataclasses.dataclass
@@ -140,6 +140,11 @@ def batches_from_queue(
                 return
             try:
                 items = queue.get_batch(batch_size, timeout=poll_interval_s)
+            except TransportWedged:
+                # a peer crashed mid-claim and frames are stuck behind the
+                # wedge: this is data loss, NOT a clean end of stream —
+                # propagate instead of flushing-and-returning like close
+                raise
             except TransportClosed:
                 # transport died mid-stream: deliver what we already hold
                 # (reference dead-queue parity = clean exit, producer.py:112-114)

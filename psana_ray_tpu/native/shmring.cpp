@@ -24,6 +24,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -51,6 +52,20 @@ struct Slot {
   // payload follows
 };
 
+// Process-local stall-watch state: remembers one (pos, seq) pair that is
+// blocking progress and when it was first observed.  If the identical
+// claimed-but-unfinished slot still blocks after stall_timeout_ms, the
+// caller gets a distinct "wedged" code instead of an indefinite
+// empty/full answer — a peer that died between claim and commit/release
+// (see the zero-copy section below) must surface as an error, not as a
+// silent permanent stall (SURVEY.md §3 quirk 5).
+struct StallWatch {
+  uint64_t pos = 0;
+  uint64_t seq = 0;
+  uint64_t since_ms = 0;
+  bool armed = false;
+};
+
 struct Ring {
   Header* hdr;
   uint8_t* base;
@@ -58,7 +73,31 @@ struct Ring {
   int fd;
   bool owner;
   char name[256];
+  uint64_t stall_timeout_ms = 5000;  // 0 disables wedge detection
+  StallWatch get_watch;   // consumer side: claimed-but-uncommitted slot
+  StallWatch put_watch;   // producer side: acquired-but-unreleased slot
 };
+
+inline uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+// Returns true when the same blocking (pos, seq) has persisted beyond the
+// ring's stall timeout.  Any change of pos or seq re-arms the watch: the
+// queue is making progress, however slowly.
+inline bool stall_check(Ring* r, StallWatch* w, uint64_t pos, uint64_t seq) {
+  if (r->stall_timeout_ms == 0) return false;
+  if (!w->armed || w->pos != pos || w->seq != seq) {
+    w->armed = true;
+    w->pos = pos;
+    w->seq = seq;
+    w->since_ms = now_ms();
+    return false;
+  }
+  return now_ms() - w->since_ms >= r->stall_timeout_ms;
+}
 
 inline size_t slot_stride(uint64_t slot_bytes) {
   // keep slots cache-line aligned
@@ -156,7 +195,40 @@ void* shmring_attach(const char* name) {
   return r;
 }
 
-// put: 1 = enqueued, 0 = full, -1 = message too large, -2 = closed.
+namespace {
+
+// Shared "full" handling for put/reserve: 0 = plain full, -4 = the slot
+// blocking us was CLAIMED by a consumer (tail moved past it) but never
+// released for stall_timeout_ms — that consumer is gone; the ring is
+// wedged and every producer will stall here forever.
+int full_or_wedged(Ring* r, Header* h, uint64_t pos, uint64_t seq) {
+  h->n_put_rejected.fetch_add(1, std::memory_order_relaxed);
+  uint64_t prev = pos - h->capacity;  // the enqueue this slot still holds
+  if (h->tail.load(std::memory_order_acquire) > prev) {
+    if (stall_check(r, &r->put_watch, pos, seq)) return -4;
+  } else {
+    r->put_watch.armed = false;  // normal full: consumers just behind
+  }
+  return 0;
+}
+
+// Shared "empty" handling for get/acquire: -1 = plain empty, -4 = the
+// slot was claimed by a producer (head moved past it) but never
+// committed for stall_timeout_ms — that producer is gone.
+int empty_or_wedged(Ring* r, Header* h, uint64_t pos, uint64_t seq) {
+  if (h->closed.load(std::memory_order_acquire)) return -2;
+  if (h->head.load(std::memory_order_acquire) > pos) {
+    if (stall_check(r, &r->get_watch, pos, seq)) return -4;
+  } else {
+    r->get_watch.armed = false;  // genuinely empty
+  }
+  return -1;
+}
+
+}  // namespace
+
+// put: 1 = enqueued, 0 = full, -1 = message too large, -2 = closed,
+// -4 = wedged (see full_or_wedged).
 int shmring_put(void* handle, const uint8_t* data, uint64_t len) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
@@ -174,12 +246,12 @@ int shmring_put(void* handle, const uint8_t* data, uint64_t len) {
         std::memcpy(reinterpret_cast<uint8_t*>(s) + sizeof(Slot), data, len);
         s->seq.store(pos + 1, std::memory_order_release);
         h->n_put.fetch_add(1, std::memory_order_relaxed);
+        r->put_watch.armed = false;
         return 1;
       }
       // CAS failed: pos was reloaded, retry
     } else if (dif < 0) {
-      h->n_put_rejected.fetch_add(1, std::memory_order_relaxed);
-      return 0;  // full
+      return full_or_wedged(r, h, pos, seq);
     } else {
       pos = h->head.load(std::memory_order_relaxed);
     }
@@ -187,7 +259,8 @@ int shmring_put(void* handle, const uint8_t* data, uint64_t len) {
 }
 
 // get: >=0 payload length copied into out, -1 = empty, -2 = closed,
-// -3 = out buffer too small (message left in place).
+// -3 = out buffer too small (message left in place), -4 = wedged (see
+// empty_or_wedged).
 int64_t shmring_get(void* handle, uint8_t* out, uint64_t out_cap) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
@@ -206,11 +279,11 @@ int64_t shmring_get(void* handle, uint8_t* out, uint64_t out_cap) {
         std::memcpy(out, reinterpret_cast<uint8_t*>(s) + sizeof(Slot), len);
         s->seq.store(pos + h->capacity, std::memory_order_release);
         h->n_get.fetch_add(1, std::memory_order_relaxed);
+        r->get_watch.armed = false;
         return (int64_t)len;
       }
     } else if (dif < 0) {
-      if (h->closed.load(std::memory_order_acquire)) return -2;
-      return -1;  // empty
+      return empty_or_wedged(r, h, pos, seq);
     } else {
       pos = h->tail.load(std::memory_order_relaxed);
     }
@@ -227,11 +300,12 @@ int64_t shmring_get(void* handle, uint8_t* out, uint64_t out_cap) {
 // is claimed with the same head/tail CAS before the pointer is handed
 // out. Tradeoff: a process that crashes between claim and
 // commit/release leaves that slot permanently in-flight and the ring
-// eventually wedges on it; the copying put/get have the same window,
-// just narrower (their memcpy). Crash recovery is destroy + recreate.
+// wedges on it; the copying put/get have the same window, just narrower
+// (their memcpy). The StallWatch above turns that silent stall into a
+// loud -4 after stall_timeout_ms; recovery is destroy + recreate.
 
 // rc: 1 = claimed (out_ptr -> slot payload, ticket -> pass to commit),
-// 0 = full, -2 = closed.
+// 0 = full, -2 = closed, -4 = wedged (see full_or_wedged).
 int shmring_reserve(void* handle, uint8_t** out_ptr, uint64_t* ticket) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
@@ -245,11 +319,11 @@ int shmring_reserve(void* handle, uint8_t** out_ptr, uint64_t* ticket) {
       if (h->head.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed)) {
         *out_ptr = reinterpret_cast<uint8_t*>(s) + sizeof(Slot);
         *ticket = pos;
+        r->put_watch.armed = false;
         return 1;
       }
     } else if (dif < 0) {
-      h->n_put_rejected.fetch_add(1, std::memory_order_relaxed);
-      return 0;  // full
+      return full_or_wedged(r, h, pos, seq);
     } else {
       pos = h->head.load(std::memory_order_relaxed);
     }
@@ -265,7 +339,7 @@ void shmring_commit(void* handle, uint64_t ticket, uint64_t len) {
 }
 
 // rc: payload length >= 0 (out_ptr -> slot payload, ticket -> pass to
-// release), -1 = empty, -2 = closed.
+// release), -1 = empty, -2 = closed, -4 = wedged (see empty_or_wedged).
 int64_t shmring_acquire(void* handle, const uint8_t** out_ptr, uint64_t* ticket) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
@@ -279,11 +353,11 @@ int64_t shmring_acquire(void* handle, const uint8_t** out_ptr, uint64_t* ticket)
       if (h->tail.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed)) {
         *out_ptr = reinterpret_cast<uint8_t*>(s) + sizeof(Slot);
         *ticket = pos;
+        r->get_watch.armed = false;
         return (int64_t)s->len;
       }
     } else if (dif < 0) {
-      if (h->closed.load(std::memory_order_acquire)) return -2;
-      return -1;  // empty
+      return empty_or_wedged(r, h, pos, seq);
     } else {
       pos = h->tail.load(std::memory_order_relaxed);
     }
@@ -314,6 +388,12 @@ uint64_t shmring_slot_bytes(void* handle) {
 
 int shmring_is_closed(void* handle) {
   return (int)static_cast<Ring*>(handle)->hdr->closed.load(std::memory_order_acquire);
+}
+
+// Per-handle wedge-detection window (ms); 0 disables. Applies to this
+// process's view only — each attached process runs its own watch.
+void shmring_set_stall_timeout(void* handle, uint64_t ms) {
+  static_cast<Ring*>(handle)->stall_timeout_ms = ms;
 }
 
 void shmring_close(void* handle) {

@@ -29,7 +29,7 @@ import numpy as np
 from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, SourceConfig, TransportConfig
 from psana_ray_tpu.records import EndOfStream, FrameRecord
 from psana_ray_tpu.sources import open_source
-from psana_ray_tpu.transport import BackoffPolicy, Registry, TransportClosed
+from psana_ray_tpu.transport import BackoffPolicy, Registry, TransportClosed, TransportWedged
 from psana_ray_tpu.transport.addressing import open_queue
 from psana_ray_tpu.utils.metrics import PipelineMetrics
 
@@ -70,6 +70,8 @@ class _Sender:
                     accepted = self.queue.put_batch(self.pending)
                 else:
                     accepted = 1 if self.queue.put(self.pending[0]) else 0
+            except TransportWedged:
+                raise  # a crashed peer wedged the ring: error, not clean exit
             except TransportClosed:
                 return False
             if accepted:
@@ -188,6 +190,8 @@ class ProducerRuntime:
                 while not self._queue.put_wait(eos, timeout=5.0):
                     if self._stop.is_set():
                         return
+            except TransportWedged:
+                raise  # crashed-peer wedge: surface it, don't log-and-exit
             except TransportClosed:
                 logger.warning("queue died before EOS could be delivered")
                 return

@@ -234,13 +234,15 @@ class EosTally:
             return_to_queue(queue, self._pending_dups, what="sibling EOS marker")
             self._pending_dups = []
             return
-        from psana_ray_tpu.transport.registry import TransportClosed
+        from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 
         kept = []
         for eos in self._pending_dups:
             try:
                 if not queue.put(eos):
                     kept.append(eos)
+            except TransportWedged:
+                raise  # crashed-peer wedge is an error, not a drained queue
             except TransportClosed:
                 self._pending_dups = []
                 return

@@ -18,4 +18,5 @@ from psana_ray_tpu.transport.registry import (  # noqa: F401
     Registry,
     RendezvousTimeout,
     TransportClosed,
+    TransportWedged,
 )

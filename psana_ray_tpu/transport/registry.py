@@ -28,6 +28,18 @@ class TransportClosed(RuntimeError):
     (``data_reader.py:46-48``)."""
 
 
+class TransportWedged(TransportClosed):
+    """A peer process died mid-operation (claimed a queue slot and never
+    committed/released it), permanently blocking the queue at that slot.
+    Subclasses :class:`TransportClosed` so it is never mistaken for
+    starvation — the silent-stall failure mode the reference's
+    error-swallowing queue exhibits (SURVEY.md §3 quirk 5). Handlers that
+    treat *closure* as a clean end of stream (batcher tail-flush, producer
+    clean exit, EOS delivery) explicitly re-raise this subclass: a wedge
+    means data loss, never normal completion. Recovery: destroy and
+    recreate the ring; in-flight items in the wedged region are lost."""
+
+
 class RendezvousTimeout(TimeoutError):
     """Queue never appeared. Parity: ``producer.py:67``."""
 

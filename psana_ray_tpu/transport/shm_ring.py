@@ -30,7 +30,7 @@ from psana_ray_tpu.transport.codec import TAG_PICKLE as _TAG_PICKLE
 from psana_ray_tpu.transport.codec import TAG_RECORD as _TAG_RECORD
 from psana_ray_tpu.transport.codec import TAG_VOID as _TAG_VOID
 from psana_ray_tpu.transport.codec import decode_payload
-from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 from psana_ray_tpu.transport.ring import EMPTY
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
@@ -129,6 +129,7 @@ def _load_lib() -> ctypes.CDLL:
         lib.shmring_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.shmring_is_closed.restype = ctypes.c_int
         lib.shmring_is_closed.argtypes = [ctypes.c_void_p]
+        lib.shmring_set_stall_timeout.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.shmring_close.argtypes = [ctypes.c_void_p]
         lib.shmring_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
         lib.shmring_free.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -158,6 +159,21 @@ class ShmRingBuffer:
         # immutable after creation; cached so put()/put_wait spins skip
         # the FFI round trip
         self._slot_bytes = int(self._lib.shmring_slot_bytes(handle))
+        self._voids_skipped = 0
+
+    def set_stall_timeout(self, seconds: float):
+        """Wedge-detection window for THIS handle (0 disables): a slot
+        claimed by a peer but left uncommitted/unreleased longer than this
+        raises :class:`TransportWedged` instead of stalling forever."""
+        self._lib.shmring_set_stall_timeout(self._h, int(seconds * 1000))
+
+    def _wedged_msg(self, peer: str, verb: str) -> str:
+        return (
+            f"shm ring {self.name!r} is wedged: a {peer} process claimed a "
+            f"slot and never {verb} it (likely crashed mid-operation). "
+            f"Destroy and recreate the ring to recover; in-flight items in "
+            f"the wedged region are lost."
+        )
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -215,6 +231,8 @@ class ShmRingBuffer:
             return False
         if rc == -2:
             raise TransportClosed(f"shm ring {self.name!r} is closed")
+        if rc == -4:
+            raise TransportWedged(self._wedged_msg("consumer", "released"))
         mv = memoryview((ctypes.c_ubyte * slot_bytes).from_address(ptr.value)).cast("B")
         ok = False
         try:
@@ -234,20 +252,28 @@ class ShmRingBuffer:
         return True
 
     def get(self) -> Any:
-        ptr = ctypes.c_void_p()
-        ticket = ctypes.c_uint64()
-        n = self._lib.shmring_acquire(self._h, ctypes.byref(ptr), ctypes.byref(ticket))
-        if n == -1:
-            return EMPTY
-        if n == -2:
-            raise TransportClosed(f"shm ring {self.name!r} is closed")
-        try:
-            mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
-            if bytes(mv[:1]) == _TAG_VOID:  # producer-side encode failure
+        # loops past void slots (producer-side encode failures): a void is
+        # consumed-and-skipped, NOT "empty" — real items may sit right
+        # behind it, and reporting EMPTY here could convince a get_wait
+        # caller at its deadline that the queue starved
+        while True:
+            ptr = ctypes.c_void_p()
+            ticket = ctypes.c_uint64()
+            n = self._lib.shmring_acquire(self._h, ctypes.byref(ptr), ctypes.byref(ticket))
+            if n == -1:
                 return EMPTY
-            return self._decode(mv)
-        finally:
-            self._lib.shmring_release(self._h, ticket)
+            if n == -2:
+                raise TransportClosed(f"shm ring {self.name!r} is closed")
+            if n == -4:
+                raise TransportWedged(self._wedged_msg("producer", "committed"))
+            try:
+                mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
+                if bytes(mv[:1]) == _TAG_VOID:
+                    self._voids_skipped += 1
+                    continue
+                return self._decode(mv)
+            finally:
+                self._lib.shmring_release(self._h, ticket)
 
     def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.0002) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -304,6 +330,7 @@ class ShmRingBuffer:
             "puts": int(buf[1]),
             "gets": int(buf[2]),
             "puts_rejected": int(buf[3]),
+            "voids_skipped": self._voids_skipped,
         }
 
     def disconnect(self):

@@ -1,10 +1,14 @@
 """Standalone queue server — the ``ray start --head`` of this framework.
 
-The reference's runbook starts a Ray head node whose GCS hosts the detached
-queue actor (``README.md:13-18``, ``shared_queue.py:35``); producers and
-consumers on other nodes join it by address. Here the equivalent service is
-one process serving a bounded queue over TCP (:mod:`transport.tcp`), which
-remote producers/consumers reach with ``--address tcp://host:port``.
+The reference's runbook starts a Ray head node whose GCS hosts detached
+queue actors by (namespace, name) (``README.md:13-18``,
+``shared_queue.py:33-38``); producers and consumers on other nodes join it
+by address. Here the equivalent service is one process hosting *many named
+queues* over TCP (:mod:`transport.tcp` OPEN opcode): clients reach it with
+``--address tcp://host:port`` and their configured (namespace, queue_name)
+get-or-creates the queue server-side — one server serves every detector's
+stream. Named queues are detached: they outlive the clients that created
+them, until this process stops.
 
 Optionally backed by a shared-memory ring (``--shm``) so local processes on
 the serving host can bypass TCP entirely while remote ones fan in/out over
@@ -33,7 +37,12 @@ def main(argv=None):
         "--shm",
         default=None,
         metavar="NAME",
-        help="back the server with shm ring NAME (local procs attach via shm://NAME)",
+        help=(
+            "back queues with shm rings: the default queue uses ring NAME "
+            "(local procs attach via shm://NAME); named queues use ring "
+            "<namespace>__<queue_name> (local procs attach via shm:// "
+            "with matching config)"
+        ),
     )
     p.add_argument("--log_level", default="INFO")
     a = p.parse_args(argv)
@@ -45,18 +54,35 @@ def main(argv=None):
     from psana_ray_tpu.transport.ring import RingBuffer
     from psana_ray_tpu.transport.tcp import TcpQueueServer
 
+    queue_factory = None
     if a.shm:
         from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
 
-        try:
-            backing = ShmRingBuffer.create(a.shm, maxsize=a.queue_size)
-        except RuntimeError:
-            backing = ShmRingBuffer.attach(a.shm, retries=1, interval_s=0.1)
-        logger.info("backing queue: shm ring %r", a.shm)
+        def _shm_backing(name, maxsize):
+            try:
+                return ShmRingBuffer.create(name, maxsize=maxsize)
+            except RuntimeError:
+                return ShmRingBuffer.attach(name, retries=1, interval_s=0.1)
+
+        backing = _shm_backing(a.shm, a.queue_size)
+        # named queues (OPEN opcode) get shm backings too, named with the
+        # SAME <namespace>__<queue_name> derivation as transport/
+        # addressing.shm_ring_name — so a local consumer using
+        # `--address shm://` with matching config reads the very ring that
+        # remote producers feed over TCP (no second copy, no TCP hop)
+        def queue_factory(ns, name, maxsize):
+            shm_name = f"{ns}__{name}"
+            logger.info("named queue (%s, %s) -> shm ring %r", ns, name, shm_name)
+            return _shm_backing(shm_name, maxsize)
+
+        logger.info("backing queues: shm rings (default ring %r)", a.shm)
     else:
         backing = RingBuffer(a.queue_size)
 
-    server = TcpQueueServer(backing, host=a.host, port=a.port).serve_background()
+    server = TcpQueueServer(
+        backing, host=a.host, port=a.port, maxsize=a.queue_size,
+        queue_factory=queue_factory,
+    ).serve_background()
     logger.info(
         "queue server listening on %s:%d (size=%d) — clients use --address tcp://<host>:%d",
         a.host, server.port, a.queue_size, server.port,
@@ -71,10 +97,7 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
     done.wait()
-    try:
-        backing.close()  # unblock clients with TransportClosed (dead-queue parity)
-    except Exception:
-        pass
+    server.close_all()  # unblock ALL clients with TransportClosed (dead-queue parity)
     server.shutdown()
     return 0
 

@@ -15,7 +15,10 @@ queue within it:
   The ring is *detached* (parity: ``shared_queue.py:35``): it outlives its
   creator until destroyed;
 - ``tcp://host:port`` — cross-host queue server (see
-  :mod:`psana_ray_tpu.queue_server`).
+  :mod:`psana_ray_tpu.queue_server`). The (namespace, queue_name) pair
+  selects a *named queue on that server* (OPEN opcode): one server per
+  cluster hosts every detector's queue, exactly like Ray's GCS hosts many
+  named actors.
 
 Producers open with ``role='producer'`` (get-or-create semantics, parity
 ``producer.py:42-48``); consumers with ``role='consumer'`` (resolve with
@@ -101,7 +104,16 @@ def open_queue(
         host, _, port = address[len("tcp://"):].partition(":")
         if not port:
             raise ValueError(f"tcp address needs host:port, got {address!r}")
-        return TcpQueueClient(host, int(port))
+        # (namespace, queue_name) select a named queue on the server —
+        # one queue server per cluster hosts every detector's queue, the
+        # role Ray's GCS plays for the reference's named actors
+        return TcpQueueClient(
+            host,
+            int(port),
+            namespace=config.namespace,
+            queue_name=config.queue_name,
+            maxsize=config.queue_size,
+        )
 
     raise ValueError(
         f"unknown address scheme {address!r} (want auto | shm://[name] | tcp://host:port)"

@@ -13,6 +13,8 @@ Wire protocol (all little-endian):
     request:  op:u8 ('P'|'G'|'S'|'C') + [P only] len:u32 + payload
               'B' (get-batch) + max_items:u32
               'Q' (put-batch) + count:u32 + count x (len:u32 + payload)
+              'O' (open) + ns_len:u16 + ns + name_len:u16 + name
+                         + maxsize:u32
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
               + [G ok] len:u32 + payload   + [S] size:u32
               + [B ok] count:u32 + count x (len:u32 + payload)
@@ -22,6 +24,16 @@ The batch opcodes exist so a cross-host consumer drains N records per
 round trip instead of reintroducing the reference's one-RPC-per-event
 bottleneck (reference ``data_reader.py:35``, SURVEY.md §3.1) over the
 network hop.
+
+The OPEN opcode makes one server a *cluster registry of named queues* —
+Ray-GCS parity for the only transport that crosses hosts (reference
+``shared_queue.py:33-38`` registers the actor by (namespace, name);
+``data_reader.py:20`` resolves it the same way). OPEN get-or-creates the
+(namespace, queue_name) queue server-side and binds this connection to
+it; connections that never send OPEN use the server's default queue
+(back-compat with single-queue deployments). Named queues are detached:
+they live until the server process stops, regardless of which client
+created them (parity: ``lifetime="detached"``, ``shared_queue.py:35``).
 
 Payloads reuse the shm codec (records wire format / tagged pickle).
 
@@ -48,6 +60,7 @@ _OP_SIZE = b"S"
 _OP_CLOSE = b"C"
 _OP_GET_BATCH = b"B"
 _OP_PUT_BATCH = b"Q"
+_OP_OPEN = b"O"
 _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
@@ -66,10 +79,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class TcpQueueServer:
-    """Serve a local queue over TCP. Start with ``serve_background()``."""
+    """Serve queues over TCP: one default queue plus any number of named
+    queues that clients OPEN by (namespace, queue_name) — see the module
+    docstring. Start with ``serve_background()``."""
 
-    def __init__(self, queue=None, host: str = "0.0.0.0", port: int = 0, maxsize: int = 100):
+    def __init__(
+        self,
+        queue=None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        maxsize: int = 100,
+        queue_factory=None,
+    ):
         self.queue = queue if queue is not None else RingBuffer(maxsize)
+        self._maxsize = maxsize
+        # factory for OPENed queues: (namespace, name, maxsize) -> queue.
+        # Default in-process rings; a server may hand out shm-backed rings
+        # instead so local clients can bypass TCP (queue_server.py --shm)
+        self._queue_factory = queue_factory or (
+            lambda ns, name, maxsize: RingBuffer(maxsize, name=f"{ns}__{name}")
+        )
+        self._queues = {}  # (namespace, name) -> queue
+        self._queues_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -77,6 +108,33 @@ class TcpQueueServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+
+    def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
+        """Get-or-create the named queue (the OPEN opcode server-side;
+        also callable in-process, e.g. for a host-local consumer of a
+        queue remote producers feed over TCP)."""
+        key = (namespace, queue_name)
+        with self._queues_lock:
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queue_factory(namespace, queue_name, maxsize or self._maxsize)
+                self._queues[key] = q
+            return q
+
+    def named_queues(self) -> List[tuple]:
+        with self._queues_lock:
+            return sorted(self._queues)
+
+    def close_all(self):
+        """Close the default + every named queue (server teardown: every
+        blocked client must observe a dead transport, ``ray stop`` parity)."""
+        with self._queues_lock:  # snapshot: OPENs race with shutdown
+            queues = [self.queue, *self._queues.values()]
+        for q in queues:
+            try:
+                q.close()
+            except Exception:
+                pass
 
     def serve_background(self) -> "TcpQueueServer":
         t = threading.Thread(target=self._accept_loop, daemon=True, name="tcp-queue-accept")
@@ -101,7 +159,7 @@ class TcpQueueServer:
             t.start()
             self._threads.append(t)
 
-    def _requeue(self, items):
+    def _requeue(self, queue, items):
         """Put back items popped but never delivered (the client connection
         died mid-response) via the shared recovery path: queue HEAD so they
         precede any EOS markers already enqueued (a tally-driven consumer
@@ -109,10 +167,11 @@ class TcpQueueServer:
         a logged drop for backings without ``put_front`` (shm ring)."""
         from psana_ray_tpu.transport.recovery import return_to_queue
 
-        return_to_queue(self.queue, items, what="in-flight frame")
+        return_to_queue(queue, items, what="in-flight frame")
 
     def _serve_conn(self, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        queue = self.queue  # rebound by OPEN; default-queue back-compat
         in_flight: List[Any] = []  # popped items whose response is pending
         try:
             while not self._stop.is_set():
@@ -121,10 +180,10 @@ class TcpQueueServer:
                     if op == _OP_PUT:
                         (n,) = struct.unpack("<I", _recv_exact(conn, 4))
                         payload = _recv_exact(conn, n)
-                        ok = self.queue.put(_decode(payload))
+                        ok = queue.put(_decode(payload))
                         conn.sendall(_ST_OK if ok else _ST_NO)
                     elif op == _OP_GET:
-                        item = self.queue.get()
+                        item = queue.get()
                         if item is EMPTY:
                             conn.sendall(_ST_NO)
                         else:
@@ -134,7 +193,7 @@ class TcpQueueServer:
                             in_flight = []
                     elif op == _OP_GET_BATCH:
                         (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        items = self.queue.get_batch(min(max_items, 4096), timeout=0.0)
+                        items = queue.get_batch(min(max_items, 4096), timeout=0.0)
                         in_flight = list(items)
                         parts = [_ST_OK, struct.pack("<I", len(items))]
                         for item in items:
@@ -154,14 +213,22 @@ class TcpQueueServer:
                             payloads.append(_recv_exact(conn, n))
                         accepted = 0
                         for payload in payloads:
-                            if not self.queue.put(_decode(payload)):
+                            if not queue.put(_decode(payload)):
                                 break  # full: accepted prefix only (FIFO)
                             accepted += 1
                         conn.sendall(_ST_OK + struct.pack("<I", accepted))
                     elif op == _OP_SIZE:
-                        conn.sendall(_ST_OK + struct.pack("<I", self.queue.size()))
+                        conn.sendall(_ST_OK + struct.pack("<I", queue.size()))
                     elif op == _OP_CLOSE:
-                        self.queue.close()
+                        queue.close()
+                        conn.sendall(_ST_OK)
+                    elif op == _OP_OPEN:
+                        (ns_len,) = struct.unpack("<H", _recv_exact(conn, 2))
+                        ns = _recv_exact(conn, ns_len).decode()
+                        (nm_len,) = struct.unpack("<H", _recv_exact(conn, 2))
+                        nm = _recv_exact(conn, nm_len).decode()
+                        (maxsize,) = struct.unpack("<I", _recv_exact(conn, 4))
+                        queue = self.open_named(ns, nm, maxsize or None)
                         conn.sendall(_ST_OK)
                     else:
                         conn.sendall(_ST_ERR)
@@ -169,7 +236,7 @@ class TcpQueueServer:
                 except TransportClosed:
                     conn.sendall(_ST_CLOSED)
         except (ConnectionError, OSError):
-            self._requeue(in_flight)
+            self._requeue(queue, in_flight)
         finally:
             conn.close()
 
@@ -190,11 +257,36 @@ class TcpQueueClient:
     (``DataReaderError``, batcher tail-flush) works for both (parity role:
     ``RayActorError``, reference ``data_reader.py:36-37``)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 30.0,
+        namespace: Optional[str] = None,
+        queue_name: Optional[str] = None,
+        maxsize: int = 0,
+    ):
         self.host, self.port = host, port
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+        if namespace is not None or queue_name is not None:
+            self.open(namespace or "default", queue_name or "default", maxsize)
+
+    def open(self, namespace: str, queue_name: str, maxsize: int = 0):
+        """Bind this connection to the server-side queue named
+        ``(namespace, queue_name)``, get-or-creating it (``maxsize`` is
+        used only on create; 0 = server default). Ray-GCS named-actor
+        parity (reference ``shared_queue.py:33-38``, ``data_reader.py:20``)."""
+        ns, nm = namespace.encode(), queue_name.encode()
+        with self._lock, self._io():
+            self._sock.sendall(
+                _OP_OPEN
+                + struct.pack("<H", len(ns)) + ns
+                + struct.pack("<H", len(nm)) + nm
+                + struct.pack("<I", maxsize)
+            )
+            self._status()
 
     @contextlib.contextmanager
     def _io(self):

@@ -327,10 +327,14 @@ class TestBatchedProducerPath:
             cfg.transport.address = f"tcp://127.0.0.1:{srv.port}"
             rt = ProducerRuntime(cfg, num_local_shards=1)
             rt.run(block=True)
+            # config (namespace, queue_name) now selects a NAMED queue on
+            # the server (OPEN opcode) — the default queue stays untouched
+            assert srv.queue.stats()["puts"] == 0
+            named = srv.open_named(cfg.transport.namespace, cfg.transport.queue_name)
             # server saw far fewer put RPCs than frames (batch size 16)
-            stats = srv.queue.stats()
+            stats = named.stats()
             assert stats["puts"] == 21  # 20 frames + 1 EOS landed
-            drained = [srv.queue.get() for _ in range(21)]
+            drained = [named.get() for _ in range(21)]
             idx = [r.event_idx for r in drained if not is_eos(r)]
             assert sorted(idx) == list(range(20))
             assert sum(is_eos(r) for r in drained) == 1

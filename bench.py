@@ -472,13 +472,17 @@ def _bench_resnet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, batch_size, e
 
 
 def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, extras):
-    """Config 3: calib + PeakNet U-Net segmentation + fixed-shape peak
-    extraction, panel-as-batch."""
-    from psana_ray_tpu.models import PeakNetUNet, panels_to_nhwc
+    """Config 3: calib + PeakNet segmentation + fixed-shape peak
+    extraction, panel-as-batch. Uses PeakNetUNetTPU — the MXU-shaped
+    redesign (s2d stem, wide features at half res, d2s logit head;
+    models/unet_tpu.py) — per-pixel logits identical in contract to the
+    classic PeakNetUNet, but every conv runs at 50-100% MXU shapes
+    instead of the 6-25% its 32-channel full-res levels allowed."""
+    from psana_ray_tpu.models import PeakNetUNetTPU, panels_to_nhwc
     from psana_ray_tpu.models.peaks import find_peaks
 
     b_unet = 2  # frames per batch; panels fold into batch: [2*16, H, W, 1]
-    model = PeakNetUNet(norm="frozen")  # inference form, folded stats
+    model = PeakNetUNetTPU(norm="frozen")  # inference form, folded stats
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
         variables = jax.jit(model.init)(jax.random.key(0), jnp.zeros((1, 64, 64, 1)))

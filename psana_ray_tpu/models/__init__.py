@@ -16,4 +16,5 @@ names as the target capability set, built TPU-first:
 
 from psana_ray_tpu.models.resnet import ResNet18, ResNet50, ResNetClassifier  # noqa: F401
 from psana_ray_tpu.models.unet import PeakNetUNet  # noqa: F401
+from psana_ray_tpu.models.unet_tpu import PeakNetUNetTPU  # noqa: F401
 from psana_ray_tpu.models.heads import panels_to_nhwc  # noqa: F401

@@ -11,8 +11,10 @@ GroupNorm + SiLU; bfloat16 compute / float32 params; per-pixel logit
 output. Input is panel-as-batch NHWC (``heads.panels_to_nhwc(..,"batch")``)
 so one compiled program serves any panel count.
 
-Spatial constraint: H and W must be divisible by 2**depth (epix10k2M
-352x384 with depth<=5: 352 = 32*11, 384 = 32*12 -> depth 5 OK).
+Spatial constraint: H and W must be divisible by 2**(len(features)-1) —
+one stride-2 level per non-bottleneck feature entry (epix10k2M 352x384
+with the default 4 features: 8 | 352 and 8 | 384 -> OK; enforced with a
+clear error at the door).
 """
 
 from __future__ import annotations
@@ -87,6 +89,17 @@ class PeakNetUNet(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        n, h, w, _ = x.shape
+        # _upsample2x is exact-2x only: an odd extent at any level would
+        # surface as an opaque shape mismatch in MergeBlock, so fail at
+        # the door with the actual constraint (round-2 ADVICE)
+        quantum = 2 ** (len(self.features) - 1)
+        if h % quantum or w % quantum:
+            raise ValueError(
+                f"PeakNetUNet needs H, W divisible by {quantum} "
+                f"({len(self.features) - 1} stride-2 levels); got {h}x{w} — "
+                f"pad the panels or reduce depth"
+            )
         x = x.astype(self.dtype)
         skips = []
         # encoder

@@ -169,3 +169,73 @@ class TestMergeBlockEquivalence:
         x = jnp.asarray(rng.normal(size=(2, 5, 6, 3)).astype(np.float32))
         ref = jax.image.resize(x, (2, 10, 12, 3), "nearest")
         np.testing.assert_array_equal(np.asarray(_upsample2x(x)), np.asarray(ref))
+
+
+class TestUNetTPU:
+    """PeakNet-TPU (models/unet_tpu.py): the MXU-shaped redesign — s2d
+    stem, wide features at half resolution, depth-to-space logit head."""
+
+    def test_s2d_d2s_roundtrip(self):
+        from psana_ray_tpu.models.unet_tpu import depth_to_space, space_to_depth
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 8, 12, 3)).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(depth_to_space(space_to_depth(x, 2), 2)), np.asarray(x)
+        )
+
+    def test_s2d_is_pixel_unshuffle(self):
+        from psana_ray_tpu.models.unet_tpu import space_to_depth
+
+        x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+        p = space_to_depth(x, 2)
+        assert p.shape == (1, 2, 2, 4)
+        # packed channels are the 2x2 neighborhood of each output pixel
+        np.testing.assert_array_equal(np.asarray(p[0, 0, 0]), [0, 1, 4, 5])
+        np.testing.assert_array_equal(np.asarray(p[0, 1, 1]), [10, 11, 14, 15])
+
+    def test_forward_shape_per_pixel_logits(self):
+        from psana_ray_tpu.models import PeakNetUNetTPU
+
+        model = PeakNetUNetTPU(features=(8, 16, 32), num_classes=1)
+        x = jnp.ones((2, 32, 48, 1))
+        out = model.apply(model.init(jax.random.key(0), x), x)
+        assert out.shape == (2, 32, 48, 1)  # one logit per ORIGINAL pixel
+        assert out.dtype == jnp.float32
+
+    def test_epix_panel_geometry(self):
+        from psana_ray_tpu.models import PeakNetUNetTPU
+
+        model = PeakNetUNetTPU(features=(4, 8, 16, 32))
+        x = jnp.ones((1, 352, 384, 1))  # 16 | 352, 16 | 384
+        out = model.apply(model.init(jax.random.key(0), x), x)
+        assert out.shape == (1, 352, 384, 1)
+
+    def test_rejects_misaligned_extents(self):
+        from psana_ray_tpu.models import PeakNetUNetTPU
+
+        model = PeakNetUNetTPU(features=(8, 16))
+        x = jnp.ones((1, 30, 32, 1))  # 30 % 4 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            model.init(jax.random.key(0), x)
+
+    def test_trainable_group_norm_grads(self):
+        from psana_ray_tpu.models import PeakNetUNetTPU
+
+        model = PeakNetUNetTPU(features=(8, 16), norm="group")
+        x = jnp.ones((1, 16, 16, 1))
+        variables = model.init(jax.random.key(0), x)
+
+        def loss(v):
+            return jnp.sum(model.apply(v, x) ** 2)
+
+        g = jax.grad(loss)(variables)
+        leaves = jax.tree.leaves(g)
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+        assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)
+
+    def test_classic_unet_rejects_misaligned_extents(self):
+        model = PeakNetUNet(features=(8, 16, 32))
+        x = jnp.ones((1, 34, 32, 1))  # 34 % 4 != 0: fail loudly at the door
+        with pytest.raises(ValueError, match="divisible"):
+            model.init(jax.random.key(0), x)

@@ -10,5 +10,9 @@ with device compute) -> consumer step.
 
 from psana_ray_tpu.infeed.batcher import Batch, FrameBatcher  # noqa: F401
 from psana_ray_tpu.infeed.pipeline import DevicePrefetcher, InfeedPipeline  # noqa: F401
-from psana_ray_tpu.infeed.multihost import make_global_batch  # noqa: F401
+from psana_ray_tpu.infeed.multihost import (  # noqa: F401
+    GlobalStreamConsumer,
+    make_global_Batch,
+    make_global_batch,
+)
 from psana_ray_tpu.infeed.fanin import DetectorStream, FanInPipeline  # noqa: F401

@@ -47,6 +47,21 @@ class Batch:
     def batch_size(self) -> int:
         return len(self.frames)
 
+    def map_arrays(self, fn) -> "Batch":
+        """A copy with ``fn`` applied to every per-row array field (frames,
+        valid, and metadata) — THE single enumeration of those fields, so
+        device placement (pipeline) and global assembly (multihost) cannot
+        drift when a field is added. ``num_valid`` (host int) passes
+        through untouched."""
+        return dataclasses.replace(
+            self,
+            frames=fn(self.frames),
+            valid=fn(self.valid),
+            shard_rank=fn(self.shard_rank),
+            event_idx=fn(self.event_idx),
+            photon_energy=fn(self.photon_energy),
+        )
+
 
 class FrameBatcher:
     """Accumulates FrameRecords into fixed-shape Batches.

@@ -1,4 +1,4 @@
-"""Multi-host global batches: per-host local shards -> one global jax.Array.
+"""Multi-host streaming: per-host ingest -> one global-batch SPMD consumer.
 
 The reference's N MPI producer ranks each push into one central queue
 (SURVEY.md §3.3 — every frame makes two network hops). The TPU-native
@@ -7,17 +7,34 @@ batch exists as a sharded ``jax.Array`` over the pod mesh — device-to-device
 traffic rides ICI inside the pjit'd computation, and no frame ever visits a
 central broker.
 
-``make_global_batch`` wraps ``jax.make_array_from_process_local_data``: on a
-single-host mesh it degenerates to a plain sharded device_put, so the same
-consumer code runs unchanged from laptop CPU mesh to pod."""
+Three layers:
+
+- :func:`make_global_batch` — one array: wraps
+  ``jax.make_array_from_process_local_data`` (degenerates to a sharded
+  device_put on a single-host mesh, so the same consumer code runs
+  unchanged from laptop CPU mesh to pod);
+- :func:`make_global_Batch` — a full :class:`~psana_ray_tpu.infeed.batcher.
+  Batch` (frames + valid + per-row metadata), every field globally
+  sharded the same way;
+- :class:`GlobalStreamConsumer` — the ASSEMBLED loop: this host's
+  transport queue -> fixed-shape batcher -> global Batch -> SPMD ``step``,
+  with the uneven-tail protocol of SURVEY.md §7 hard part (d): a host
+  whose stream drains first keeps participating with all-padding batches
+  (the global assembly is collective — every host must call it the same
+  number of times), and the loop ends only when a global valid-count says
+  EVERY host is out of real frames, so all hosts exit on the same round.
+"""
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from psana_ray_tpu.infeed.batcher import Batch, batches_from_queue
+from psana_ray_tpu.utils.metrics import PipelineMetrics
 
 
 def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
@@ -48,3 +65,138 @@ def make_global_batch(
         else (global_batch_size, *local_frames.shape[1:])
     )
     return jax.make_array_from_process_local_data(sharding, local_frames, global_shape)
+
+
+def make_global_Batch(local: Batch, mesh: Mesh, data_axis: str = "data") -> Batch:
+    """Assemble a full local :class:`Batch` into a globally sharded one:
+    frames, the valid mask, and all per-row metadata are sharded
+    ``P(data_axis)`` together so a pjit/shard_map step sees aligned rows.
+
+    ``num_valid`` stays this HOST's real-row count (a host int, no device
+    sync) — the global count is ``sum(valid)`` on device when needed
+    (:class:`GlobalStreamConsumer` uses exactly that for termination)."""
+    return local.map_arrays(
+        lambda a: make_global_batch(np.asarray(a), mesh, data_axis)
+    )
+
+
+class GlobalStreamConsumer:
+    """Per-host ingest feeding one global-batch SPMD consumer loop.
+
+    Every participating process constructs this with ITS OWN transport
+    queue (fed by its local producers) and the SAME mesh/batch geometry,
+    then calls :meth:`run` with the same step function — the multi-host
+    realization of the reference's consume loop, with the central queue
+    actor replaced by per-host queues + the sharded global batch.
+
+    Termination protocol (uneven tails, SURVEY.md §7 hard part (d)): the
+    global assembly is collective, so a host whose local stream hits EOS
+    first cannot simply stop — it keeps contributing all-padding batches
+    (``valid`` all zero). Each round, one tiny jitted reduction counts the
+    GLOBAL valid rows; when it hits zero every host breaks on the same
+    round. That reduction is one small device sync per round — the price
+    of a globally consistent stop without any out-of-band control plane.
+
+    ``frame_shape``/``frame_dtype`` describe the padding batches for a
+    host that drains before contributing any real batch (it cannot infer
+    the geometry from a stream it never saw).
+    """
+
+    def __init__(
+        self,
+        queue,
+        local_batch_size: int,
+        mesh: Mesh,
+        frame_shape: Tuple[int, ...],
+        frame_dtype=np.float32,
+        data_axis: str = "data",
+        poll_interval_s: float = 0.01,
+        metrics: Optional[PipelineMetrics] = None,
+    ):
+        self.queue = queue
+        self.local_batch_size = local_batch_size
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.frame_shape = tuple(frame_shape)
+        self.frame_dtype = np.dtype(frame_dtype)
+        self.poll_interval_s = poll_interval_s
+        self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
+        self._pad: Optional[Batch] = None
+
+    def _padding_batch(self) -> Batch:
+        # cached: a drained host may spin many identical all-padding
+        # rounds on the pod's collective critical path, and at epix scale
+        # each fresh zeros() would be a ~300 MB allocation
+        if self._pad is None:
+            b = self.local_batch_size
+            self._pad = Batch(
+                frames=np.zeros((b, *self.frame_shape), self.frame_dtype),
+                valid=np.zeros((b,), np.uint8),
+                shard_rank=np.zeros((b,), np.int32),
+                event_idx=np.zeros((b,), np.int64),
+                photon_energy=np.zeros((b,), np.float32),
+                num_valid=0,
+            )
+        return self._pad
+
+    def run(
+        self,
+        step: Callable[[Batch], Any],
+        on_result: Optional[Callable] = None,
+        block_until_ready: bool = False,
+    ) -> int:
+        """Drive ``step`` over global batches until every host's stream is
+        done; returns the number of REAL frames this host contributed.
+
+        A local transport failure (e.g. :class:`TransportWedged`) must NOT
+        abandon the collective loop outright: the other hosts would block
+        forever in their next global assembly/reduction. This host instead
+        degrades to all-padding rounds — letting the global valid-count
+        wind the whole pod down in bounded time — and re-raises the
+        original error once the loop has terminated everywhere."""
+        import jax.numpy as jnp
+
+        from psana_ray_tpu.infeed.pipeline import drive_step
+        from psana_ray_tpu.transport.registry import TransportClosed
+
+        global_valid = jax.jit(lambda v: jnp.sum(v.astype(jnp.int32)))
+        it = iter(
+            batches_from_queue(
+                self.queue,
+                self.local_batch_size,
+                poll_interval_s=self.poll_interval_s,
+            )
+        )
+        exhausted = False
+        deferred: Optional[BaseException] = None
+        n_local = 0
+        while True:
+            local = None
+            if not exhausted:
+                try:
+                    local = next(it)
+                except StopIteration:
+                    exhausted = True
+                except TransportClosed as e:
+                    # keep participating with padding so peers terminate;
+                    # surface the fault after the collective winds down
+                    exhausted = True
+                    deferred = e
+            if local is None:
+                local = self._padding_batch()
+            g = make_global_Batch(local, self.mesh, self.data_axis)
+            if int(global_valid(g.valid)) == 0:
+                break  # same decision on every host: same global value
+            out = drive_step(
+                self.metrics,
+                step,
+                g,
+                block_until_ready,
+                nbytes=int(local.frames.nbytes),  # THIS host's ingest bytes
+            )
+            n_local += local.num_valid
+            if on_result is not None:
+                on_result(out, g)
+        if deferred is not None:
+            raise deferred
+        return n_local

@@ -10,7 +10,6 @@ transfer < compute)."""
 
 from __future__ import annotations
 
-import dataclasses
 import queue as _queue
 import threading
 import time
@@ -64,16 +63,8 @@ class DevicePrefetcher:
             return self._place(batch)
 
     def _place(self, batch: Batch):
-        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
-        return dataclasses.replace(
-            batch,
-            frames=put(batch.frames),
-            valid=put(batch.valid),
-            shard_rank=put(batch.shard_rank),
-            event_idx=put(batch.event_idx),
-            photon_energy=put(batch.photon_energy),
-            # num_valid stays the host int — counting on-device would sync
-        )
+        # num_valid stays the host int — counting on-device would sync
+        return batch.map_arrays(lambda x: jax.device_put(x, self._sharding))
 
     def _put(self, item) -> bool:
         """Bounded put that aborts when close() is called."""
@@ -134,12 +125,21 @@ class DevicePrefetcher:
         return item
 
 
-def drive_step(metrics: PipelineMetrics, step, batch, block_until_ready: bool = False):
+def drive_step(
+    metrics: PipelineMetrics,
+    step,
+    batch,
+    block_until_ready: bool = False,
+    nbytes: Optional[int] = None,
+):
     """Run one consumer step over a device batch, recording frame count,
     bytes, and step latency. ``block_until_ready`` makes the recorded
     latency a true per-batch device latency instead of dispatch time —
     the honest number for the <5 ms p50 target (BASELINE.md). Shared by
-    :meth:`InfeedPipeline.run` and ``FanInPipeline.run``."""
+    :meth:`InfeedPipeline.run`, ``FanInPipeline.run``, and the multi-host
+    loop — the latter passes ``nbytes`` explicitly (this HOST's ingest
+    bytes; the global sharded array's nbytes would overcount by the
+    process count)."""
     t0 = time.monotonic()
     with annotate("pipeline.step"):
         out = step(batch)
@@ -148,7 +148,7 @@ def drive_step(metrics: PipelineMetrics, step, batch, block_until_ready: bool = 
     metrics.observe_batch(
         batch.num_valid,
         time.monotonic() - t0,
-        nbytes=int(getattr(batch.frames, "nbytes", 0)),
+        nbytes=int(getattr(batch.frames, "nbytes", 0)) if nbytes is None else nbytes,
     )
     return out
 

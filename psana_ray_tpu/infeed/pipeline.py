@@ -169,7 +169,20 @@ class InfeedPipeline:
         poll_interval_s: float = 0.01,
         max_wait_s: Optional[float] = None,
         metrics: Optional[PipelineMetrics] = None,
+        place_on_device: bool = True,
+        batcher_buffers: int = 0,
     ):
+        """``place_on_device=False`` keeps batches as host numpy arrays —
+        for host-pipeline measurement or host-only consumers, where the
+        device_put would be a pure extra frame-sized memcpy."""
+        if batcher_buffers > 0 and batcher_buffers < prefetch_depth + 3:
+            # alive at once: prefetch_depth queued + 1 with the consumer
+            # + 1 being filled + 1 margin for an async/aliasing device_put
+            raise ValueError(
+                f"batcher_buffers={batcher_buffers} can recycle a batch "
+                f"still alive downstream; need >= prefetch_depth + 3 = "
+                f"{prefetch_depth + 3} (see FrameBatcher.n_buffers contract)"
+            )
         self.queue = queue
         self.batch_size = batch_size
         self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
@@ -180,12 +193,14 @@ class InfeedPipeline:
             poll_interval_s=poll_interval_s,
             max_wait_s=max_wait_s,
             stop=stop,
+            n_buffers=batcher_buffers,
         )
         self._prefetcher = DevicePrefetcher(
             self._batches,
             sharding=sharding,
             prefetch_depth=prefetch_depth,
             stop_event=stop,
+            to_device=None if place_on_device else (lambda b: b),
         )
 
     def __iter__(self) -> Iterator[Batch]:

@@ -280,3 +280,75 @@ class TestUint16Stream:
             assert b.frames.dtype == np.uint16  # stream stays u16 to the device
             assert out.dtype == np.float32  # calibration upcasts on device
             assert bool(jax.numpy.isfinite(out).all())
+
+
+class TestPooledBatcher:
+    """FrameBatcher(n_buffers=K): recycled batch-buffer arena (round-3
+    fan-in profiling: fresh 100+ MB allocations were re-page-faulted every
+    batch — see utils/hostmem.py and PERF_NOTES)."""
+
+    def test_pool_reuses_buffers_round_robin(self):
+        b = FrameBatcher(batch_size=2, n_buffers=2)
+        batches = []
+        for i in range(8):
+            out = b.push(_rec(i))
+            if out is not None:
+                batches.append(out)
+        assert len(batches) == 4
+        # buffer identity cycles with period n_buffers
+        ids = [id(x.frames) for x in batches]
+        assert ids[0] == ids[2] and ids[1] == ids[3] and ids[0] != ids[1]
+        # the most recent n_buffers batches hold correct (un-clobbered) data
+        np.testing.assert_array_equal(batches[2].frames[0, 0, 0, 0], 4.0)
+        np.testing.assert_array_equal(batches[3].frames[1, 0, 0, 0], 7.0)
+
+    def test_pooled_tail_padding_zeroes_stale_rows(self):
+        b = FrameBatcher(batch_size=4, n_buffers=1)
+        for i in range(4):
+            assert b.push(_rec(i)) is not None or i < 3  # first batch full
+        # second, partial fill of the SAME recycled buffer
+        b.push(_rec(10))
+        tail = b.flush()
+        assert tail.num_valid == 1
+        np.testing.assert_array_equal(tail.valid, [1, 0, 0, 0])
+        # stale rows from the previous batch must be zeroed, not leaked
+        np.testing.assert_array_equal(tail.frames[1:], 0.0)
+        assert float(tail.frames[0, 0, 0, 0]) == 10.0
+        np.testing.assert_array_equal(tail.event_idx[1:], 0)
+
+    def test_eager_copy_releases_source(self):
+        # push copies immediately: mutating the source after push must not
+        # change the emitted batch
+        b = FrameBatcher(batch_size=2)
+        r = _rec(1)
+        b.push(r)
+        r.panels[:] = -1.0
+        out = b.push(_rec(2))
+        assert float(out.frames[0, 0, 0, 0]) == 1.0
+
+
+class TestHostOnlyPipeline:
+    def test_place_on_device_false_yields_numpy(self):
+        q = RingBuffer(maxsize=8)
+        for i in range(4):
+            q.put(_rec(i))
+        q.put(EndOfStream(total_events=4))
+        pipe = InfeedPipeline(q, batch_size=4, place_on_device=False)
+        got = list(pipe)
+        assert len(got) == 1
+        assert isinstance(got[0].frames, np.ndarray)  # no device_put copy
+        assert got[0].num_valid == 4
+
+    def test_pipeline_rejects_undersized_pool(self):
+        q = RingBuffer(maxsize=4)
+        with pytest.raises(ValueError, match="batcher_buffers"):
+            InfeedPipeline(q, batch_size=2, prefetch_depth=2, batcher_buffers=2)
+
+    def test_fanin_rejects_undersized_pool(self):
+        from psana_ray_tpu.infeed import DetectorStream, FanInPipeline
+
+        q = RingBuffer(maxsize=4)
+        with pytest.raises(ValueError, match="batcher_buffers"):
+            FanInPipeline(
+                [DetectorStream("d", q, batch_size=2, batcher_buffers=3)]
+            )

@@ -483,6 +483,7 @@ def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, extras):
     classic PeakNetUNet, but every conv runs at 50-100% MXU shapes
     instead of the 6-25% its 32-channel full-res levels allowed."""
     from psana_ray_tpu.models import PeakNetUNetTPU, panels_to_nhwc
+    from psana_ray_tpu.models.pallas_unet import peaknet_tpu_fused_infer
     from psana_ray_tpu.models.peaks import find_peaks
 
     b_unet = 2  # frames per batch; panels fold into batch: [2*16, H, W, 1]
@@ -494,22 +495,61 @@ def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh, extras):
 
     from psana_ray_tpu.ops import fused_calibrate
 
-    @jax.jit
-    def seg(frames):
-        c = fused_calibrate(
-            frames, pedestal, gain, mask, threshold=10.0, out_dtype=jnp.bfloat16
-        )
-        logits = model.apply(variables, panels_to_nhwc(c, mode="batch"))
-        return find_peaks(logits, max_peaks=64)
+    def make_seg(apply_fn):
+        @jax.jit
+        def seg(frames):
+            c = fused_calibrate(
+                frames, pedestal, gain, mask, threshold=10.0, out_dtype=jnp.bfloat16
+            )
+            logits = apply_fn(panels_to_nhwc(c, mode="batch"))
+            return find_peaks(logits, max_peaks=64)
 
+        return seg
+
+    # fused Pallas encoder kernels first — but only after an ON-DEVICE
+    # numerical check against the XLA model: interpret-mode tests cannot
+    # catch a Mosaic lowering bug that compiles but computes garbage, and
+    # a fast-but-wrong kernel must never become the recorded number.
+    # Any failure (lowering error OR mismatch) falls back to XLA.
+    use_fused = False
+    try:
+        nhwc_warm = jax.jit(
+            lambda fr: panels_to_nhwc(
+                fused_calibrate(
+                    fr, pedestal, gain, mask, threshold=10.0, out_dtype=jnp.bfloat16
+                ),
+                mode="batch",
+            )
+        )(x_warm[:b_unet])
+        lg_fused = jax.jit(
+            lambda y: peaknet_tpu_fused_infer(variables, y)
+        )(nhwc_warm)
+        lg_xla = jax.jit(lambda y: model.apply(variables, y))(nhwc_warm)
+        scale = float(jnp.max(jnp.abs(lg_xla)))
+        err = float(jnp.max(jnp.abs(lg_fused - lg_xla))) / max(scale, 1e-3)
+        if err < 0.05:
+            use_fused = True
+        else:
+            log(f"fused U-Net MISMATCHES XLA on device (rel err {err:.3f}) — using XLA")
+            extras["unet_fused_relerr"] = round(err, 4)
+    except Exception as e:
+        log(f"fused U-Net path failed ({e!r}); falling back to XLA model")
+
+    if use_fused:
+        seg = make_seg(lambda y: peaknet_tpu_fused_infer(variables, y))
+        label, extras["unet_path"] = "calib+U-Net(fused)+peaks", "pallas-fused-encoder"
+    else:
+        seg = make_seg(lambda y: model.apply(variables, y))
+        label, extras["unet_path"] = "calib+U-Net(xla)+peaks", "xla"
     ms = device_time_ms(
-        jax, seg, (x_warm[:b_unet],), (x_fresh[:b_unet],), "calib+U-Net+peaks", extras
+        jax, seg, (x_warm[:b_unet],), (x_fresh[:b_unet],), label, extras
     )
+
     fps = b_unet / (ms / 1e3)
     extras["unet_fps"] = round(fps, 1)
     log(
-        f"calib+U-Net+peak-extraction: {ms:.1f} ms / {b_unet} frames "
-        f"device-time -> {fps:.1f} fps"
+        f"calib+U-Net+peak-extraction [{extras['unet_path']}]: {ms:.1f} ms "
+        f"/ {b_unet} frames device-time -> {fps:.1f} fps"
     )
 
 
@@ -613,7 +653,6 @@ def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras, smoke=False):
                 ]
             )
             arrivals = {epix_det: [], jf_det: []}
-            t0 = time.perf_counter()
             for p in procs:
                 p.start()
             counts = fan.run(
@@ -622,14 +661,22 @@ def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras, smoke=False):
                     jf_det: lambda b: None,
                 },
                 on_result=lambda name, out, b: arrivals[name].append(
-                    time.perf_counter()
+                    (time.perf_counter(), b.num_valid)
                 ),
             )
-            wall = time.perf_counter() - t0
             for p in procs:
                 p.join(timeout=60)
+            # rate over the first->last batch-arrival span, excluding the
+            # first batch's frames: spawn/import/attach startup of the
+            # producer processes must not be billed to merge throughput
+            merged = sorted(t for ts in arrivals.values() for t in ts)
             total = sum(counts.values())
-            host_fps = total / wall
+            if len(merged) >= 2:
+                span = merged[-1][0] - merged[0][0]
+                wall = max(span, 1e-6)
+                host_fps = (total - merged[0][1]) / wall
+            else:
+                wall, host_fps = float("nan"), 0.0
             extras["fanin_host_fps"] = round(host_fps, 1)
             extras["fanin_host_counts"] = dict(counts)
             # the pipeline is memcpy-bound: 2 producer processes + the
@@ -637,7 +684,7 @@ def _bench_fanin(jax, jnp, pool, pedestal, gain, mask, extras, smoke=False):
             # scales with core count (PERF_NOTES.md has the breakdown)
             extras["host_cpu_cores"] = os.cpu_count()
             for det in (epix_det, jf_det):
-                gaps = np.diff(arrivals[det]) * 1e3
+                gaps = np.diff([t for t, _ in arrivals[det]]) * 1e3
                 if len(gaps):
                     extras[f"fanin_{det}_batch_p50_ms"] = round(
                         float(np.percentile(gaps, 50)), 2

@@ -175,12 +175,6 @@ def _parse_device_module_durs(trace_dir: str):
     return sorted(dominant)
 
 
-def _parse_device_ms(trace_dir: str):
-    """Total XLA-module execution time (ms) on device lanes of a trace."""
-    durs = _parse_device_module_durs(trace_dir)
-    return sum(durs) if durs else None
-
-
 def device_time_ms(jax, fn, warm_args, fresh_args, label: str, extras=None):
     """Device-clock time of one dispatch of ``fn`` (see module docstring).
 
@@ -573,8 +567,9 @@ def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras):
         seg = make_seg(lambda y: model.apply(variables, y))
         label, extras["unet_path"] = "calib+U-Net(xla)+peaks", "xla"
     x_fresh = x_fresh_list[0]
+    n_samples = min(len(x_fresh_list), len(x_fresh) // b_unet)
     fresh_slices = [
-        (x_fresh[k * b_unet:(k + 1) * b_unet],) for k in range(3)
+        (x_fresh[k * b_unet:(k + 1) * b_unet],) for k in range(n_samples)
     ]
     ms = device_time_ms(jax, seg, (x_warm[:b_unet],), fresh_slices, label, extras)
 

@@ -32,7 +32,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x50525452494E4731ULL;  // "PRTRING1"
+constexpr uint64_t kMagic = 0x50525452494E4732ULL;  // "PRTRING2"
 
 struct Header {
   uint64_t magic;
@@ -41,6 +41,11 @@ struct Header {
   std::atomic<uint64_t> head;  // next enqueue position
   std::atomic<uint64_t> tail;  // next dequeue position
   std::atomic<uint64_t> closed;
+  // draining: producers are refused (they see the closed signal and exit
+  // cleanly) while consumers keep reading — graceful-teardown half-close.
+  // Cross-process by design: local shm producers that bypass a TCP
+  // server must observe the drain too.
+  std::atomic<uint64_t> draining;
   std::atomic<uint64_t> n_put;
   std::atomic<uint64_t> n_get;
   std::atomic<uint64_t> n_put_rejected;
@@ -154,6 +159,7 @@ void* shmring_create(const char* name, uint64_t capacity, uint64_t slot_bytes) {
   r->hdr->head.store(0);
   r->hdr->tail.store(0);
   r->hdr->closed.store(0);
+  r->hdr->draining.store(0);
   r->hdr->n_put.store(0);
   r->hdr->n_get.store(0);
   r->hdr->n_put_rejected.store(0);
@@ -232,7 +238,8 @@ int empty_or_wedged(Ring* r, Header* h, uint64_t pos, uint64_t seq) {
 int shmring_put(void* handle, const uint8_t* data, uint64_t len) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
-  if (h->closed.load(std::memory_order_acquire)) return -2;
+  if (h->closed.load(std::memory_order_acquire) ||
+      h->draining.load(std::memory_order_acquire)) return -2;
   if (len > h->slot_bytes) return -1;
 
   uint64_t pos = h->head.load(std::memory_order_relaxed);
@@ -309,7 +316,8 @@ int64_t shmring_get(void* handle, uint8_t* out, uint64_t out_cap) {
 int shmring_reserve(void* handle, uint8_t** out_ptr, uint64_t* ticket) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
-  if (h->closed.load(std::memory_order_acquire)) return -2;
+  if (h->closed.load(std::memory_order_acquire) ||
+      h->draining.load(std::memory_order_acquire)) return -2;
   uint64_t pos = h->head.load(std::memory_order_relaxed);
   for (;;) {
     Slot* s = slot_at(r, pos);
@@ -398,6 +406,12 @@ void shmring_set_stall_timeout(void* handle, uint64_t ms) {
 
 void shmring_close(void* handle) {
   static_cast<Ring*>(handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+// Half-close for graceful teardown: refuse producers, keep serving
+// consumers (see Header::draining).
+void shmring_begin_drain(void* handle) {
+  static_cast<Ring*>(handle)->hdr->draining.store(1, std::memory_order_release);
 }
 
 void shmring_stats(void* handle, uint64_t* out4) {

@@ -24,6 +24,7 @@ import argparse
 import logging
 import signal
 import threading
+import time
 
 logger = logging.getLogger(__name__)
 
@@ -42,6 +43,16 @@ def main(argv=None):
             "(local procs attach via shm://NAME); named queues use ring "
             "<namespace>__<queue_name> (local procs attach via shm:// "
             "with matching config)"
+        ),
+    )
+    p.add_argument(
+        "--drain_s",
+        type=float,
+        default=10.0,
+        help=(
+            "graceful-shutdown window: on SIGINT/SIGTERM the server stops "
+            "accepting PUTs but keeps serving GETs until every queue is "
+            "empty or this many seconds pass, THEN closes (0 = abrupt)"
         ),
     )
     p.add_argument("--log_level", default="INFO")
@@ -89,14 +100,35 @@ def main(argv=None):
     )
 
     done = threading.Event()
+    force = threading.Event()
 
     def _stop(sig, frame):
+        if done.is_set():
+            # second signal: the operator wants OUT now (double-Ctrl-C
+            # convention) — abort the drain window
+            logger.info("second signal %s — forcing immediate shutdown", sig)
+            force.set()
+            return
         logger.info("signal %s — shutting down queue server", sig)
         done.set()
 
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
     done.wait()
+    if a.drain_s > 0 and not force.is_set():
+        # graceful drain: producers are refused (clean dead-queue exits),
+        # consumers keep reading until the queues empty or the window ends
+        server.begin_drain()
+        start = time.monotonic()
+        while time.monotonic() - start < a.drain_s and not force.is_set():
+            if server.depth() == 0:
+                logger.info("drained — all queues empty")
+                break
+            force.wait(0.2)
+        else:
+            logger.warning(
+                "drain window ended with %d item(s) still queued", server.depth()
+            )
     server.close_all()  # unblock ALL clients with TransportClosed (dead-queue parity)
     server.shutdown()
     return 0

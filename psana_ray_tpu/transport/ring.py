@@ -57,6 +57,7 @@ class RingBuffer:
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._closed = False
+        self._draining = False
         # lifetime counters (observability the reference lacks, SURVEY.md §5)
         self._n_put = 0
         self._n_get = 0
@@ -68,6 +69,7 @@ class RingBuffer:
         Parity: ``shared_queue.py:11-14``."""
         with self._lock:
             self._check_open()
+            self._check_accepting()
             if len(self._q) >= self.maxsize:
                 self._n_put_rejected += 1
                 return False
@@ -110,9 +112,11 @@ class RingBuffer:
         """Block until space is available (or timeout). Returns success."""
         with self._not_full:
             ok = self._not_full.wait_for(
-                lambda: self._closed or len(self._q) < self.maxsize, timeout=timeout
+                lambda: self._closed or self._draining or len(self._q) < self.maxsize,
+                timeout=timeout,
             )
             self._check_open()
+            self._check_accepting()
             if not ok:
                 return False
             self._q.append(item)
@@ -159,6 +163,14 @@ class RingBuffer:
             self._not_empty.notify_all()
             self._not_full.notify_all()
 
+    def begin_drain(self):
+        """Half-close for graceful teardown: producers are refused (they
+        see the dead-queue signal and exit cleanly) while consumers keep
+        reading what is already queued."""
+        with self._lock:
+            self._draining = True
+            self._not_full.notify_all()
+
     @property
     def closed(self) -> bool:
         return self._closed
@@ -166,6 +178,10 @@ class RingBuffer:
     def _check_open(self):
         if self._closed:
             raise TransportClosed(f"queue {self.name!r} is closed")
+
+    def _check_accepting(self):
+        if self._draining:
+            raise TransportClosed(f"queue {self.name!r} is draining (shutdown)")
 
     # -- observability ---------------------------------------------------
     def stats(self) -> dict:

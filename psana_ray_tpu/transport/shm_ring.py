@@ -130,6 +130,7 @@ def _load_lib() -> ctypes.CDLL:
         lib.shmring_is_closed.restype = ctypes.c_int
         lib.shmring_is_closed.argtypes = [ctypes.c_void_p]
         lib.shmring_set_stall_timeout.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shmring_begin_drain.argtypes = [ctypes.c_void_p]
         lib.shmring_close.argtypes = [ctypes.c_void_p]
         lib.shmring_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
         lib.shmring_free.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -320,6 +321,12 @@ class ShmRingBuffer:
 
     def close(self):
         self._lib.shmring_close(self._h)
+
+    def begin_drain(self):
+        """Half-close for graceful teardown: producer puts/reserves are
+        refused (they see the closed signal, a clean exit) while gets keep
+        serving. Cross-process: every attached producer observes it."""
+        self._lib.shmring_begin_drain(self._h)
 
     def stats(self) -> dict:
         buf = (ctypes.c_uint64 * 4)()

@@ -107,6 +107,7 @@ class TcpQueueServer:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        self._draining = False
         self._threads: List[threading.Thread] = []
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
@@ -125,12 +126,46 @@ class TcpQueueServer:
         with self._queues_lock:
             return sorted(self._queues)
 
+    def all_queues(self) -> List[Any]:
+        with self._queues_lock:  # snapshot: OPENs race with shutdown
+            return [self.queue, *self._queues.values()]
+
+    def begin_drain(self):
+        """Stop accepting PUTs on every queue (producers see the dead-queue
+        signal and exit cleanly) while GETs keep serving — the graceful
+        half of teardown: consumers drain in-flight frames instead of
+        losing them to an abrupt ``close_all`` (the reference's ``ray
+        stop`` kills the actor with whatever the deque still holds).
+        Propagates to the backing queues themselves so producers that
+        BYPASS TCP (shm-backed deployments, queue_server --shm) are
+        refused too, not just the ones speaking the wire protocol."""
+        self._draining = True
+        for q in self.all_queues():
+            drain = getattr(q, "begin_drain", None)
+            if drain is not None:
+                try:
+                    drain()
+                except Exception:
+                    pass
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def depth(self) -> int:
+        """Total items still queued across the default + named queues."""
+        total = 0
+        for q in self.all_queues():
+            try:
+                total += q.size()
+            except Exception:
+                pass
+        return total
+
     def close_all(self):
         """Close the default + every named queue (server teardown: every
         blocked client must observe a dead transport, ``ray stop`` parity)."""
-        with self._queues_lock:  # snapshot: OPENs race with shutdown
-            queues = [self.queue, *self._queues.values()]
-        for q in queues:
+        for q in self.all_queues():
             try:
                 q.close()
             except Exception:
@@ -179,7 +214,10 @@ class TcpQueueServer:
                 try:
                     if op == _OP_PUT:
                         (n,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        payload = _recv_exact(conn, n)
+                        payload = _recv_exact(conn, n)  # read BEFORE any
+                        if self._draining:              # refusal: no desync
+                            conn.sendall(_ST_CLOSED)
+                            continue
                         ok = queue.put(_decode(payload))
                         conn.sendall(_ST_OK if ok else _ST_NO)
                     elif op == _OP_GET:
@@ -211,6 +249,9 @@ class TcpQueueServer:
                         for _ in range(count):
                             (n,) = struct.unpack("<I", _recv_exact(conn, 4))
                             payloads.append(_recv_exact(conn, n))
+                        if self._draining:
+                            conn.sendall(_ST_CLOSED)
+                            continue
                         accepted = 0
                         for payload in payloads:
                             if not queue.put(_decode(payload)):

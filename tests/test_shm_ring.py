@@ -241,3 +241,23 @@ def test_wedge_propagates_as_error_through_batcher():
 
     with pytest.raises(TransportWedged):
         list(batches_from_queue(WedgedQueue(), batch_size=4))
+
+
+def test_drain_refuses_producers_serves_consumers():
+    """Cross-process drain: a producer that bypasses any TCP server and
+    writes straight into the ring must still be refused during drain,
+    while consumers keep reading what's queued."""
+    name = f"drain_{os.getpid()}"
+    ring = ShmRingBuffer.create(name, maxsize=8, slot_bytes=4096)
+    try:
+        assert ring.put({"i": 0}) and ring.put({"i": 1})
+        other = ShmRingBuffer.attach(name, retries=2, interval_s=0.1)
+        ring.begin_drain()
+        with pytest.raises(TransportClosed):
+            other.put({"i": 2})  # attached producer sees the refusal
+        assert ring.get() == {"i": 0}  # gets keep serving
+        assert other.get() == {"i": 1}
+        assert ring.get() is EMPTY
+        other.disconnect()
+    finally:
+        ring.destroy()

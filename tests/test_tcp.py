@@ -328,3 +328,41 @@ class TestShmBackedNamedQueues:
             if shm_consumer is not None:
                 shm_consumer.destroy()
             srv.shutdown()
+
+
+class TestGracefulDrain:
+    """Server shutdown drains instead of dropping: begin_drain refuses
+    PUTs (producers see the dead-queue signal, clean exit) while GETs keep
+    serving until the queues empty — the in-flight frames the reference's
+    `ray stop` would destroy with the actor survive to the consumers."""
+
+    def test_drain_refuses_puts_serves_gets(self, server):
+        prod = TcpQueueClient("127.0.0.1", server.port, namespace="n", queue_name="q")
+        cons = TcpQueueClient("127.0.0.1", server.port, namespace="n", queue_name="q")
+        try:
+            for i in range(3):
+                assert prod.put({"i": i})
+            server.begin_drain()
+            with pytest.raises(TransportClosed):
+                prod.put({"i": 99})  # producers refused
+            # consumers drain everything already queued
+            assert [cons.get()["i"] for _ in range(3)] == [0, 1, 2]
+            assert server.depth() == 0
+        finally:
+            prod.disconnect()
+            cons.disconnect()
+
+    def test_drain_covers_default_and_named(self, server, client):
+        named = TcpQueueClient("127.0.0.1", server.port, namespace="n", queue_name="d")
+        try:
+            assert client.put("anon")
+            assert named.put("named")
+            assert server.depth() == 2
+            server.begin_drain()
+            with pytest.raises(TransportClosed):
+                named.put_batch(["x"])
+            assert client.get() == "anon"
+            assert named.get() == "named"
+            assert server.depth() == 0
+        finally:
+            named.disconnect()

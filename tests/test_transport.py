@@ -193,3 +193,18 @@ def test_put_front_returns_item_to_head():
     q.put(2)  # full
     assert q.put_front(0)  # recovery path may exceed maxsize
     assert [q.get() for _ in range(3)] == [0, 1, 2]
+
+
+def test_ring_drain_refuses_puts_serves_gets():
+    from psana_ray_tpu.transport import RingBuffer, TransportClosed
+
+    q = RingBuffer(maxsize=4)
+    assert q.put(1) and q.put(2)
+    q.begin_drain()
+    import pytest as _pytest
+
+    with _pytest.raises(TransportClosed):
+        q.put(3)
+    with _pytest.raises(TransportClosed):
+        q.put_wait(3, timeout=0.5)
+    assert q.get() == 1 and q.get() == 2

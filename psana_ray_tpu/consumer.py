@@ -155,6 +155,9 @@ def main(argv=None):
     import logging
     import signal
 
+    from psana_ray_tpu.utils.hostmem import enable_large_alloc_reuse
+
+    enable_large_alloc_reuse()  # MB-scale frame buffers: heap reuse, no re-faulting
     p = argparse.ArgumentParser(prog="psana-ray-tpu-consumer")
     p.add_argument("consumer_id", type=int, nargs="?", default=0)
     p.add_argument("--ray_address", "--address", dest="address", default="auto")

@@ -315,6 +315,9 @@ def shard_topology(args) -> tuple:
 
 
 def main(argv=None):
+    from psana_ray_tpu.utils.hostmem import enable_large_alloc_reuse
+
+    enable_large_alloc_reuse()  # MB-scale frame buffers: heap reuse, no re-faulting
     config, args = parse_arguments(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),

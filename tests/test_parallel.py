@@ -154,3 +154,25 @@ def test_train_step_remat_matches_plain(mesh):
     flat_r = jax.tree.leaves(out["remat"][1].variables)
     for a, b in zip(flat_p, flat_r):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unet_tpu_train_step_runs(mesh):
+    """The MXU-shaped PeakNet-TPU (models/unet_tpu.py) must be trainable
+    with the same sharded train-step machinery as the classic model —
+    GroupNorm form, focal segmentation loss, batch sharded P('data')."""
+    import optax
+
+    from psana_ray_tpu.models import PeakNetUNetTPU
+
+    model = PeakNetUNetTPU(features=(4, 8), num_classes=1, norm="group")
+    sample = jnp.ones((8, 16, 32, 1))
+    opt = optax.sgd(1e-2)
+    state = create_train_state(model, opt, jax.random.key(0), sample, mesh)
+    x = jax.device_put(sample, NamedSharding(mesh, P("data")))
+    targets = jnp.zeros((8, 16, 32, 1))
+    step = make_train_step(
+        model, opt, lambda logits, aux: masked_sigmoid_focal(logits, aux[0], aux[1])
+    )
+    state, loss = step(state, x, (targets, jnp.ones((8,))))
+    assert np.isfinite(float(loss))
+    assert int(state.step) == 1

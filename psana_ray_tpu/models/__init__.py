@@ -18,3 +18,4 @@ from psana_ray_tpu.models.resnet import ResNet18, ResNet50, ResNetClassifier  # 
 from psana_ray_tpu.models.unet import PeakNetUNet  # noqa: F401
 from psana_ray_tpu.models.unet_tpu import PeakNetUNetTPU  # noqa: F401
 from psana_ray_tpu.models.heads import panels_to_nhwc  # noqa: F401
+from psana_ray_tpu.models.init import host_init  # noqa: F401

@@ -239,3 +239,67 @@ class TestUNetTPU:
         x = jnp.ones((1, 34, 32, 1))  # 34 % 4 != 0: fail loudly at the door
         with pytest.raises(ValueError, match="divisible"):
             model.init(jax.random.key(0), x)
+
+
+class TestHostInit:
+    """host_init / eval_shape_init: the backend-independent param build.
+
+    The fallback exists for environments whose JAX plugin registers ONLY a
+    remote TPU platform (no cpu backend to jit init on; remote init is
+    minutes — PERF_NOTES.md). On this CPU test host we call the fallback
+    directly."""
+
+    def test_eval_shape_init_matches_real_init_structure(self):
+        from psana_ray_tpu.models.init import eval_shape_init
+
+        model = ResNet18(num_classes=2, width=16, norm="frozen")
+        shape = (1, 32, 32, 4)
+        fake = eval_shape_init(model, shape)
+        real = model.init(jax.random.key(0), jnp.zeros(shape))
+        assert jax.tree_util.tree_structure(fake) == jax.tree_util.tree_structure(real)
+        for (pf, lf), (pr, lr) in zip(
+            jax.tree_util.tree_leaves_with_path(fake),
+            jax.tree_util.tree_leaves_with_path(real),
+        ):
+            assert pf == pr
+            assert lf.shape == lr.shape, pf
+            assert np.dtype(lf.dtype) == np.dtype(lr.dtype), pf
+
+    def test_eval_shape_init_forward_is_sane(self):
+        # conventions (kernel ~ 1/sqrt(fan_in), scale=1, bias=0) must keep
+        # activations O(1) through the full stack: finite, nonzero logits
+        from psana_ray_tpu.models.init import eval_shape_init
+
+        model = ResNet18(num_classes=2, width=16, norm="frozen")
+        fake = eval_shape_init(model, (1, 32, 32, 4))
+        out = model.apply(fake, jnp.ones((2, 32, 32, 4)))
+        arr = np.asarray(out, np.float32)
+        assert np.isfinite(arr).all()
+        assert np.abs(arr).max() > 0
+        assert np.abs(arr).max() < 1e3
+
+    def test_eval_shape_init_unet_frozen(self):
+        from psana_ray_tpu.models import PeakNetUNetTPU
+        from psana_ray_tpu.models.init import eval_shape_init
+
+        model = PeakNetUNetTPU(features=(8, 16), norm="frozen")
+        fake = eval_shape_init(model, (1, 16, 16, 1))
+        out = model.apply(fake, jnp.ones((1, 16, 16, 1)))
+        assert out.shape == (1, 16, 16, 1)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def test_host_init_prefers_cpu_backend_when_available(self):
+        # on this host a cpu backend exists, so host_init must be
+        # bit-identical to the model's own jitted init
+        from psana_ray_tpu.models import host_init
+
+        model = ResNet18(num_classes=2, width=16)
+        shape = (1, 32, 32, 4)
+        got = host_init(model, shape)
+        want = jax.jit(model.init)(jax.random.key(0), jnp.zeros(shape))
+        for (pg, lg), (pw, lw) in zip(
+            jax.tree_util.tree_leaves_with_path(got),
+            jax.tree_util.tree_leaves_with_path(want),
+        ):
+            assert pg == pw
+            np.testing.assert_array_equal(np.asarray(lg), np.asarray(lw))

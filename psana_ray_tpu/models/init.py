@@ -29,12 +29,17 @@ from typing import Any, Sequence
 import numpy as np
 
 
-def _leaf_name(path_entry: Any) -> str:
-    # jax key-path entries: DictKey(key='kernel') / GetAttrKey / SequenceKey
-    for attr in ("key", "name", "idx"):
-        if hasattr(path_entry, attr):
-            return str(getattr(path_entry, attr))
-    return str(path_entry)
+def _leaf_name(path) -> str:
+    """Parameter name for a key path: the LAST dict key in it.
+
+    Boxed params (flax ``LogicallyPartitioned`` from ``with_partitioning``)
+    append a ``GetAttrKey(name='value')`` entry after the real name, so
+    ``path[-1]`` would be ``'value'`` for every leaf — walk backwards to
+    the last DictKey instead."""
+    for entry in reversed(path):
+        if hasattr(entry, "key"):
+            return str(entry.key)
+    return str(path[-1]) if path else ""
 
 
 def host_init(
@@ -103,7 +108,7 @@ def eval_shape_init(
     rng = np.random.default_rng(seed)
 
     def build(path, sd):
-        name = _leaf_name(path[-1]).lower()
+        name = _leaf_name(path).lower()
         shape = tuple(sd.shape)
         dtype = np.dtype(sd.dtype)
         if "scale" in name or "var" in name:

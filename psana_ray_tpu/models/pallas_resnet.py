@@ -533,6 +533,24 @@ def resnet_fused_infer(
     """
     from flax.core import meta
 
+    # the fused stage pipeline needs every strided stage's input to keep
+    # >= 2 rows (stem+pool divide by 4, each stage after the first by 2;
+    # a 1-row input to a stride-2 stage means 0-row polyphase planes ->
+    # bogus kernel slices), so fall back to the plain flax forward below
+    # that — those shapes are toy/test geometries, not detector panels
+    min_extent = 4 * 2 ** (len(stage_sizes) - 1)
+    if x.shape[1] < min_extent or x.shape[2] < min_extent:
+        from psana_ray_tpu.models.resnet import ResNetClassifier
+
+        pp = meta.unbox(variables)["params"]
+        model = ResNetClassifier(
+            stage_sizes=stage_sizes,
+            num_classes=pp["head"]["kernel"].shape[-1],
+            width=pp["stem"]["kernel"].shape[-1],
+            norm="frozen",
+        )
+        return model.apply(variables, x)
+
     p = meta.unbox(variables)["params"]
     x = x.astype(_BF16)
 

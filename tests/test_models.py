@@ -278,6 +278,24 @@ class TestHostInit:
         assert np.abs(arr).max() > 0
         assert np.abs(arr).max() < 1e3
 
+    def test_eval_shape_init_naming_conventions_fire(self):
+        # the leaf-name heuristic must see through flax's partitioning
+        # boxes (paths end in GetAttrKey('value')): norm scales exactly 1,
+        # biases exactly 0, conv kernels fan-in-scaled — NOT the generic
+        # 0.02*randn else-branch for everything
+        from flax.core import meta
+
+        from psana_ray_tpu.models.init import eval_shape_init
+
+        model = ResNet18(num_classes=2, width=16, norm="frozen")
+        fake = meta.unbox(eval_shape_init(model, (1, 32, 32, 4)))["params"]
+        stem_norm = fake["stem_norm"]
+        np.testing.assert_array_equal(np.asarray(stem_norm["scale"]), 1.0)
+        np.testing.assert_array_equal(np.asarray(stem_norm["bias"]), 0.0)
+        k = np.asarray(fake["stem"]["kernel"], np.float32)
+        fan_in = float(np.prod(k.shape[:-1]))
+        assert 0.5 / np.sqrt(fan_in) < k.std() < 2.0 / np.sqrt(fan_in)
+
     def test_eval_shape_init_unet_frozen(self):
         from psana_ray_tpu.models import PeakNetUNetTPU
         from psana_ray_tpu.models.init import eval_shape_init

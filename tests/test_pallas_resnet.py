@@ -155,3 +155,21 @@ class TestResNetFusedInfer:
         ref = model.apply(variables, x)
         got = resnet_fused_infer(variables, x, stage_sizes=stage_sizes, interpret=True)
         assert _rel_err(ref, got) < 0.05
+
+
+def test_small_extent_falls_back_to_flax():
+    """Inputs too small for the fused stage pipeline (deep stages would
+    degenerate to 0 rows) must run the plain flax forward, not crash in a
+    kernel slice — the bench smoke geometry (16x128) hit exactly this."""
+    from psana_ray_tpu.models import ResNet50, host_init, panels_to_nhwc
+    from psana_ray_tpu.models.pallas_resnet import resnet_fused_infer
+
+    model = ResNet50(num_classes=2, norm="frozen")
+    v = host_init(model, (1, 16, 128, 2))
+    x = jnp.ones((3, 2, 16, 128))  # [B, panels, H, W]
+    out = resnet_fused_infer(v, panels_to_nhwc(x))
+    ref = model.apply(v, panels_to_nhwc(x))
+    assert out.shape == (3, 2)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=1e-5
+    )

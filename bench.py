@@ -645,112 +645,138 @@ def _fanin_producer_proc(ring_name: str, det: str, n: int, seed: int):
     ring.disconnect()
 
 
-def _bench_fanin_host(extras, smoke=False):
-    """Config 5, host leg: ``fanin_host_fps`` — the HOST merge pipeline
-    at volume: >=1000 u16 frames per detector from two separate PRODUCER
-    PROCESSES through shm rings into one FanInPipeline consumer (no-op
-    step) — sustained aggregate fps + per-detector rate and p50 batch
-    cadence.  This is the kHz demonstration; it does not touch the
-    device."""
+def _fanin_host_pass(det_a, det_b, n_a, n_b, batch_a, batch_b, extras, prefix, label):
+    """One two-producer-process shm fan-in pass; returns aggregate fps.
+
+    Records ``{prefix}_fps`` / ``{prefix}_counts`` and per-detector batch
+    cadence p50 under ``{prefix}_{det}_batch_p50_ms``."""
     import multiprocessing as mp
 
     from psana_ray_tpu.infeed import DetectorStream, FanInPipeline
-    from psana_ray_tpu.transport.shm_ring import ShmRingBuffer, native_available
+    from psana_ray_tpu.sources.base import DETECTORS
+    from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
 
-    epix_det = "smoke_a" if smoke else "epix10k2M"
-    jf_det = "smoke_b" if smoke else "jungfrau4M"
-
-    if native_available():
-        n_epix_host, n_jf_host = (64, 32) if smoke else (1200, 600)
-        uid = f"{os.getpid()}_{int(time.time())}"
-        rings = {}
-        procs = []
-        ctx = mp.get_context("spawn")
-        try:
-            for det, n, seed in (
-                (epix_det, n_epix_host, 1),
-                (jf_det, n_jf_host, 2),
-            ):
-                from psana_ray_tpu.sources.base import DETECTORS
-
-                frame_bytes = int(np.prod(DETECTORS[det].frame_shape)) * 2
-                rings[det] = ShmRingBuffer.create(
-                    f"fanin_{det}_{uid}", maxsize=16,
-                    slot_bytes=frame_bytes + 4096,
+    uid = f"{os.getpid()}_{int(time.time()*1e3)}"
+    rings = {}
+    procs = []
+    ctx = mp.get_context("spawn")
+    try:
+        for det, n, seed in ((det_a, n_a, 1), (det_b, n_b, 2)):
+            frame_bytes = int(np.prod(DETECTORS[det].frame_shape)) * 2
+            rings[det] = ShmRingBuffer.create(
+                f"fanin_{det}_{uid}", maxsize=16,
+                slot_bytes=frame_bytes + 4096,
+            )
+            procs.append(
+                ctx.Process(
+                    target=_fanin_producer_proc,
+                    args=(f"fanin_{det}_{uid}", det, n, seed),
+                    daemon=True,
                 )
-                procs.append(
-                    ctx.Process(
-                        target=_fanin_producer_proc,
-                        args=(f"fanin_{det}_{uid}", det, n, seed),
-                        daemon=True,
-                    )
+            )
+        # host metric: no device placement (that copy belongs to the
+        # device leg, measured separately). Buffer recycling comes from
+        # enable_large_alloc_reuse() (heap reuse of the per-batch
+        # allocations), not the batcher pool — on the 1-core build host
+        # the pool's upfront page-faulting measured as a wash; see
+        # PERF_NOTES.md round 3.
+        fan = FanInPipeline(
+            [
+                DetectorStream(det_a, rings[det_a], batch_size=batch_a,
+                               poll_interval_s=0.002, place_on_device=False,
+                               batcher_buffers=0),
+                DetectorStream(det_b, rings[det_b], batch_size=batch_b,
+                               poll_interval_s=0.002, place_on_device=False,
+                               batcher_buffers=0),
+            ]
+        )
+        arrivals = {det_a: [], det_b: []}
+        for p in procs:
+            p.start()
+        counts = fan.run(
+            {
+                det_a: lambda b: None,  # host merge rate: no device
+                det_b: lambda b: None,
+            },
+            on_result=lambda name, out, b: arrivals[name].append(
+                (time.perf_counter(), b.num_valid)
+            ),
+        )
+        for p in procs:
+            p.join(timeout=60)
+        # rate over the first->last batch-arrival span, excluding the
+        # first batch's frames: spawn/import/attach startup of the
+        # producer processes must not be billed to merge throughput
+        merged = sorted(t for ts in arrivals.values() for t in ts)
+        total = sum(counts.values())
+        if len(merged) >= 2:
+            span = merged[-1][0] - merged[0][0]
+            wall = max(span, 1e-6)
+            host_fps = (total - merged[0][1]) / wall
+        else:
+            wall, host_fps = float("nan"), 0.0
+        extras[f"{prefix}_fps"] = round(host_fps, 1)
+        extras[f"{prefix}_counts"] = dict(counts)
+        for det in (det_a, det_b):
+            gaps = np.diff([t for t, _ in arrivals[det]]) * 1e3
+            if len(gaps):
+                extras[f"{prefix}_{det}_batch_p50_ms"] = round(
+                    float(np.percentile(gaps, 50)), 2
                 )
-            # host metric: no device placement (that copy belongs to the
-            # device leg, measured separately below). Buffer recycling
-            # comes from enable_large_alloc_reuse() (heap reuse of the
-            # per-batch allocations), not the batcher pool — on the
-            # 1-core build host the pool's upfront page-faulting measured
-            # as a wash; see PERF_NOTES.md round 3.
-            fan = FanInPipeline(
-                [
-                    DetectorStream(epix_det, rings[epix_det], batch_size=32,
-                                   poll_interval_s=0.002, place_on_device=False,
-                                   batcher_buffers=0),
-                    DetectorStream(jf_det, rings[jf_det], batch_size=16,
-                                   poll_interval_s=0.002, place_on_device=False,
-                                   batcher_buffers=0),
-                ]
-            )
-            arrivals = {epix_det: [], jf_det: []}
-            for p in procs:
-                p.start()
-            counts = fan.run(
-                {
-                    epix_det: lambda b: None,  # host merge rate: no device
-                    jf_det: lambda b: None,
-                },
-                on_result=lambda name, out, b: arrivals[name].append(
-                    (time.perf_counter(), b.num_valid)
-                ),
-            )
-            for p in procs:
-                p.join(timeout=60)
-            # rate over the first->last batch-arrival span, excluding the
-            # first batch's frames: spawn/import/attach startup of the
-            # producer processes must not be billed to merge throughput
-            merged = sorted(t for ts in arrivals.values() for t in ts)
-            total = sum(counts.values())
-            if len(merged) >= 2:
-                span = merged[-1][0] - merged[0][0]
-                wall = max(span, 1e-6)
-                host_fps = (total - merged[0][1]) / wall
-            else:
-                wall, host_fps = float("nan"), 0.0
-            extras["fanin_host_fps"] = round(host_fps, 1)
-            extras["fanin_host_counts"] = dict(counts)
-            # the pipeline is memcpy-bound: 2 producer processes + the
-            # consumer all timeshare this host's cores, so the ceiling
-            # scales with core count (PERF_NOTES.md has the breakdown)
-            extras["host_cpu_cores"] = os.cpu_count()
-            for det in (epix_det, jf_det):
-                gaps = np.diff([t for t, _ in arrivals[det]]) * 1e3
-                if len(gaps):
-                    extras[f"fanin_{det}_batch_p50_ms"] = round(
-                        float(np.percentile(gaps, 50)), 2
-                    )
-            log(
-                f"fan-in HOST rate [shm, 2 producer procs, u16]: {counts} "
-                f"in {wall:.2f}s -> {host_fps:.0f} fps aggregate "
-                f"(per-det batch-cadence p50 in extras)"
-            )
-        finally:
-            for r in rings.values():
-                try:
-                    r.destroy()
-                except Exception:
-                    pass
-    else:
+        log(
+            f"fan-in HOST rate [{label}]: {counts} in {wall:.2f}s -> "
+            f"{host_fps:.0f} fps aggregate"
+        )
+        return host_fps
+    finally:
+        for r in rings.values():
+            try:
+                r.destroy()
+            except Exception:
+                pass
+
+
+def _bench_fanin_host(extras, smoke=False):
+    """Config 5, host leg — two passes, neither touching the device:
+
+    - ``fanin_host_fps``: detector-native volume (>=1000 u16 frames per
+      detector, epix10k2M + jungfrau4M) — MEMORY-BANDWIDTH-bound: ~3
+      frame-sized copies/frame split across 3 processes timesharing this
+      host's cores, so the ceiling scales with core count
+      (``host_cpu_cores`` is recorded; PERF_NOTES.md has the breakdown).
+    - ``fanin_record_rate_fps``: the same merge machinery at small frame
+      size (records bound, not bandwidth) — demonstrates the per-record
+      pipeline overhead itself clears kHz even on one core.
+    """
+    from psana_ray_tpu.transport.shm_ring import native_available
+
+    if not native_available():
         log("fan-in host-rate demo skipped: native shm unavailable")
+        return
+
+    extras["host_cpu_cores"] = os.cpu_count()
+    # each pass individually guarded: a failure in one (e.g. /dev/shm too
+    # small for the 8 MB jungfrau slots) must not cost the other's number
+    try:
+        if smoke:
+            _fanin_host_pass(
+                "smoke_a", "smoke_b", 64, 32, 32, 16, extras,
+                "fanin_host", "smoke volume",
+            )
+        else:
+            _fanin_host_pass(
+                "epix10k2M", "jungfrau4M", 1200, 600, 32, 16, extras,
+                "fanin_host", "shm, 2 producer procs, u16, bandwidth-bound",
+            )
+    except Exception as e:
+        log(f"fan-in volume pass skipped: {e!r}")
+    try:
+        _fanin_host_pass(
+            "smoke_a", "smoke_b", 2000, 1000, 64, 32, extras,
+            "fanin_record_rate", "shm, 2 producer procs, small frames, record-bound",
+        )
+    except Exception as e:
+        log(f"fan-in record-rate pass skipped: {e!r}")
 
 
 def _bench_fanin_device(jax, jnp, pool, pedestal, gain, mask, extras, smoke=False):

@@ -25,9 +25,16 @@ break cross-hop renormalization), and the ring's running (m, num, den)
 carry is float32 for the same reason.
 
 Layouts match ring_attention.py: global ``[B, S, H, D]`` sharded
-``P(None, seq_axis)``. This path is forward-only — reverse-mode AD raises
-immediately (custom_vjp with an erroring backward); use
-:func:`ring_attention` for training.
+``P(None, seq_axis)``.
+
+Differentiability: :func:`flash_attention` carries a full flash VJP
+(backward kernels regenerate probability tiles from the saved row
+log-sum-exp — no stored score matrix in either direction), which also
+powers ``ulysses_attention(impl='flash')`` for long-context TRAINING.
+The stats-returning :func:`attention_with_stats` and the hop-combining
+:func:`ring_flash_attention` remain forward-only serving paths (their
+lse outputs would need their own cotangent handling); use
+:func:`ring_attention` for training a ring layout.
 """
 
 from __future__ import annotations
@@ -85,6 +92,27 @@ def _xla_attention_with_stats(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Arr
 # ---------------------------------------------------------------------------
 
 
+def _causal_tile_mask(qi, kb, block_q, block_k):
+    """[block_q, block_k] bool, True where the entry is in the FUTURE
+    (k index > q index) — shared by the forward and backward kernels so
+    their masking can never desynchronize."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return cols > rows
+
+
+def _tile_live(qi, kb, block_q, block_k):
+    """False when the whole (qi, kb) tile is in the causal future — its
+    contribution is exactly zero, so kernels skip the tile body outright
+    (~2x FLOPs saved on causal at long S; the README advertises this at
+    hop level for the ring, the same structure applies at tile level)."""
+    return (qi + 1) * block_q > kb * block_k
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, sm_scale, causal, n_kb
@@ -100,41 +128,45 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros((block_q, 1), jnp.float32)
         acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
-    s = (
-        jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+    def _tile_body():
+        s = (
+            jax.lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # [block_q, block_k]
+        if causal:
+            s = jnp.where(_causal_tile_mask(qi, kb, block_q, block_k), NEG_INF, s)
+
+        m = m_ref[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # masked scores are exactly NEG_INF; on a fully-dead tile m_new
+        # stays NEG_INF and exp(s - m_new) would be exp(0) = 1 — zero
+        # them explicitly
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        * sm_scale
-    )  # [block_q, block_k]
-    if causal:
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        cols = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(cols > rows, NEG_INF, s)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + pv
 
-    m = m_ref[:]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    # masked scores are exactly NEG_INF; on a fully-dead tile m_new stays
-    # NEG_INF and exp(s - m_new) would be exp(0) = 1 — zero them explicitly
-    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
-    alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
-    pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[:] = m_new
-    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + pv
+    if causal:
+        pl.when(_tile_live(qi, kb, block_q, block_k))(_tile_body)
+    else:
+        _tile_body()
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+        # lse rides in [bh, 1, sq] layout: 2D [bh, sq] blocks would need a
+        # (1, block_q) block whose second-to-last dim Mosaic rejects (must
+        # be divisible by 8 or equal the array dim)
+        lse_ref[0, 0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
 
 
 def _pallas_attention_with_stats(
@@ -164,11 +196,11 @@ def _pallas_attention_with_stats(
         ],
         out_specs=[
             pl.BlockSpec((1, _BLOCK_Q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, _BLOCK_Q), lambda i, j, kb: (i, j)),
+            pl.BlockSpec((1, 1, _BLOCK_Q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((_BLOCK_Q, 1), jnp.float32),
@@ -186,26 +218,239 @@ def _kernel_shapes_ok(q, k) -> bool:
     return d % 128 == 0 and sq % _BLOCK_Q == 0 and sk % _BLOCK_K == 0
 
 
+# ---------------------------------------------------------------------------
+# Flash backward (the standard two-kernel formulation).  With the forward's
+# residuals (q, k, v, o, lse) the normalized probabilities regenerate per
+# tile as p = exp(scale·qk − lse) — no stored score matrix, same VMEM
+# independence from sequence length as the forward.  Given
+# delta_i = Σ_d do_id·o_id (precomputed in XLA, one cheap fused reduce):
+#
+#     dv = pᵀ @ do
+#     ds = p ⊙ (do @ vᵀ − delta)          (softmax Jacobian, normalized p)
+#     dq = scale · ds @ k                  (accumulated over key blocks)
+#     dk = scale · dsᵀ @ q                 (accumulated over query blocks)
+#
+# Two kernels because the two accumulations want opposite grid orders:
+# dkv iterates query blocks innermost (dk/dv tiles resident), dq iterates
+# key blocks innermost (dq tile resident).  Masked entries are explicitly
+# zeroed in p — exp(NEG_INF − lse) is NOT reliably 0 when a row is fully
+# masked (lse ≈ NEG_INF makes the exponent ≈ 0, i.e. p ≈ 1).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_tile_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
+                   sm_scale, causal, qi, kb, block_q, block_k):
+    """Shared per-tile math: normalized probabilities + ds (both f32)."""
+    s = (
+        jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * sm_scale
+    )  # [block_q, block_k]
+    p = jnp.exp(s - lse_blk[:, None])
+    if causal:
+        p = jnp.where(_causal_tile_mask(qi, kb, block_q, block_k), 0.0, p)
+    dp = jax.lax.dot_general(
+        do_blk, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_blk[:, None]) * sm_scale
+    return p, ds
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, sm_scale, causal, n_qb
+):
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    block_q = q_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _reset():
+        dk_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+
+    def _tile_body():
+        p, ds = _bwd_tile_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0, 0],
+            delta_ref[0, 0], sm_scale, causal, qi, kb, block_q, block_k,
+        )
+        # dv += pᵀ @ do ; dk += dsᵀ @ q  (contract the query axis)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(_tile_live(qi, kb, block_q, block_k))(_tile_body)
+    else:
+        _tile_body()
+
+    @pl.when(qi == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, sm_scale, causal, n_kb
+):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+
+    @pl.when(kb == 0)
+    def _reset():
+        dq_acc[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    def _tile_body():
+        _, ds = _bwd_tile_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0, 0],
+            delta_ref[0, 0], sm_scale, causal, qi, kb, block_q, block_k,
+        )
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(_tile_live(qi, kb, block_q, block_k))(_tile_body)
+    else:
+        _tile_body()
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _pallas_attention_bwd(
+    q, k, v, o, lse, do, causal: bool, interpret: bool = False
+):
+    """[B,H,S,D] flash backward; returns (dq, dk, dv) in the input dtypes."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    sm_scale = d**-0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qf, kf, vf = (x.reshape(bh, -1, d) for x in (q, k, v))
+    dof = do.reshape(bh, sq, d)
+    # [bh, 1, sq] stats layout — see the forward's lse note on Mosaic's
+    # last-two-dims block constraint
+    lsef = lse.reshape(bh, 1, sq)
+    deltaf = delta.reshape(bh, 1, sq)
+    n_qb, n_kb = sq // _BLOCK_Q, sk // _BLOCK_K
+
+    qspec = pl.BlockSpec((1, _BLOCK_Q, d), lambda i, a, b_: (i, b_, 0))
+    kspec = pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, a, 0))
+    rowspec = pl.BlockSpec((1, 1, _BLOCK_Q), lambda i, a, b_: (i, 0, b_))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, n_qb=n_qb
+        ),
+        grid=(bh, n_kb, n_qb),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, a, 0)),
+            pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, a, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK_K, d), jnp.float32),
+            pltpu.VMEM((_BLOCK_K, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    qspec2 = pl.BlockSpec((1, _BLOCK_Q, d), lambda i, a, b_: (i, a, 0))
+    kspec2 = pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, b_, 0))
+    rowspec2 = pl.BlockSpec((1, 1, _BLOCK_Q), lambda i, a, b_: (i, 0, a))
+    (dq,) = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, n_kb=n_kb
+        ),
+        grid=(bh, n_qb, n_kb),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[pl.BlockSpec((1, _BLOCK_Q, d), lambda i, a, b_: (i, a, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((_BLOCK_Q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (
+        dq.reshape(b, h, sq, d),
+        dk.reshape(b, h, sk, d),
+        dv.reshape(b, h, sk, d),
+    )
+
+
+def _xla_attention_bwd(q, k, v, o, lse, do, causal: bool):
+    """Reference backward from the same residuals (normalized p from lse);
+    used off-TPU and for odd shapes — materializes the score matrix."""
+    sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((ki > qi)[None, None], NEG_INF, s)
+    p = jnp.exp(s - lse[..., None])
+    if causal:
+        p = jnp.where((ki > qi)[None, None], 0.0, p)
+    dof = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _attention_core(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Array]:
+    """Undifferentiated (o, lse) in ``[B, H, S, D]``: Pallas flash kernel
+    when the backend and shapes allow (D and both sequence lengths
+    multiples of 128), else the XLA formulation."""
+    if jax.default_backend() == "tpu" and _kernel_shapes_ok(q, k):
+        return _pallas_attention_with_stats(q, k, v, causal)
+    return _xla_attention_with_stats(q, k, v, causal)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def attention_with_stats(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
 ) -> Tuple[jax.Array, jax.Array]:
     """Attention + row log-sum-exp, ``[B, H, S, D]`` layout.
 
-    Dispatches to the vendored Pallas flash kernel when the backend and
-    shapes allow (D and both sequence lengths multiples of 128), else the
-    XLA formulation. Both return ``o`` in the query dtype and ``lse`` in
-    float32 — the statistics two hops combine must never be bf16.
+    Both paths return ``o`` in the query dtype and ``lse`` in float32 —
+    the statistics two hops combine must never be bf16.  This
+    stats-returning form is FORWARD-ONLY (its lse output would need its
+    own cotangent handling); :func:`flash_attention` is the
+    differentiable entry.
     """
-    if jax.default_backend() == "tpu" and _kernel_shapes_ok(q, k):
-        return _pallas_attention_with_stats(q, k, v, causal)
-    return _xla_attention_with_stats(q, k, v, causal)
+    return _attention_core(q, k, v, causal)
 
 
-def _aws_fwd(causal, q, k, v):
+def _aws_fwd(q, k, v, causal):
     raise NotImplementedError(
         "attention_with_stats / ring_flash_attention are forward-only "
-        "serving paths; use parallel.ring_attention for training."
+        "serving paths; use flash_attention (flash VJP) or "
+        "parallel.ring_attention for training."
     )
 
 
@@ -216,16 +461,39 @@ def _aws_bwd(causal, res, g):  # pragma: no cover - fwd already raises
 attention_with_stats.defvjp(_aws_fwd, _aws_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
 ) -> jax.Array:
     """Single-device attention, repo layout ``[B, S, H, D]`` (the
-    long-sequence path when the whole context fits one chip)."""
-    o, _ = attention_with_stats(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-        causal=causal,
-    )
+    long-sequence path when the whole context fits one chip).
+
+    Differentiable: the VJP regenerates probabilities per tile from the
+    saved (q, k, v, o, lse) residuals — flash memory behavior in both
+    directions, no stored score matrix (kernel shapes permitting; odd
+    shapes and non-TPU backends use the XLA formulation)."""
+    qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o, _ = _attention_core(qh, kh, vh, causal)
     return o.transpose(0, 2, 1, 3)
+
+
+def _fa_fwd(q, k, v, causal):
+    qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o, lse = _attention_core(qh, kh, vh, causal)
+    return o.transpose(0, 2, 1, 3), (qh, kh, vh, o, lse)
+
+
+def _fa_bwd(causal, res, g):
+    qh, kh, vh, o, lse = res
+    doh = g.transpose(0, 2, 1, 3)
+    if jax.default_backend() == "tpu" and _kernel_shapes_ok(qh, kh):
+        dq, dk, dv = _pallas_attention_bwd(qh, kh, vh, o, lse, doh, causal)
+    else:
+        dq, dk, dv = _xla_attention_bwd(qh, kh, vh, o, lse, doh, causal)
+    return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
 def ring_flash_attention(

@@ -124,15 +124,25 @@ def ulysses_attention(
     mesh: Mesh,
     seq_axis: str = "seq",
     causal: bool = False,
+    impl: str = "reference",
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
     Re-shards ``[B, S/P, H, D] -> [B, S, H/P, D]`` with one all-to-all,
     runs full-sequence attention per head group, and restores the layout
-    with a second all-to-all. Requires H % P == 0."""
+    with a second all-to-all. Requires H % P == 0.
+
+    ``impl='flash'`` runs the per-head-group attention through
+    :func:`parallel.flash.flash_attention` — fully differentiable with
+    flash memory behavior in both directions (its VJP regenerates
+    probability tiles from the saved lse instead of storing the score
+    matrix), making this the long-context TRAINING path at scale;
+    ``'reference'`` is the exact O(S²)-memory formulation."""
     p_devices = mesh.shape[seq_axis]
     if q.shape[2] % p_devices != 0:
         raise ValueError(f"heads {q.shape[2]} not divisible by {seq_axis}={p_devices}")
+    if impl not in ("reference", "flash"):
+        raise ValueError(f"impl must be 'reference' or 'flash', got {impl!r}")
     spec = P(None, seq_axis, None, None)
 
     def local(q, k, v):
@@ -144,7 +154,12 @@ def ulysses_attention(
             return lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2, tiled=True)
 
         qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-        of = reference_attention(qf, kf, vf, causal=causal)
+        if impl == "flash":
+            from psana_ray_tpu.parallel.flash import flash_attention
+
+            of = flash_attention(qf, kf, vf, causal=causal)
+        else:
+            of = reference_attention(qf, kf, vf, causal=causal)
         return gather_seq(of)
 
     return shard_map(

@@ -44,7 +44,6 @@ the popped item(s).
 
 from __future__ import annotations
 
-import contextlib
 import socket
 import struct
 import threading
@@ -61,6 +60,7 @@ _OP_CLOSE = b"C"
 _OP_GET_BATCH = b"B"
 _OP_PUT_BATCH = b"Q"
 _OP_OPEN = b"O"
+_OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
@@ -109,6 +109,8 @@ class TcpQueueServer:
         self._stop = threading.Event()
         self._draining = False
         self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
         """Get-or-create the named queue (the OPEN opcode server-side;
@@ -174,11 +176,15 @@ class TcpQueueServer:
     def serve_background(self) -> "TcpQueueServer":
         t = threading.Thread(target=self._accept_loop, daemon=True, name="tcp-queue-accept")
         t.start()
+        self._accept_thread = t
         self._threads.append(t)
         return self
 
     def _accept_loop(self):
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:  # shutdown() closed the socket before we got here
+            return
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
@@ -190,6 +196,9 @@ class TcpQueueServer:
             # long-lived service (queue_server.py) and must not grow
             # unboundedly across client reconnects
             self._threads = [t for t in self._threads if t.is_alive()]
+            with self._conns_lock:
+                self._conns = [c for c in self._conns if c.fileno() != -1]
+                self._conns.append(conn)
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -207,10 +216,20 @@ class TcpQueueServer:
     def _serve_conn(self, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         queue = self.queue  # rebound by OPEN; default-queue back-compat
-        in_flight: List[Any] = []  # popped items whose response is pending
+        # Items popped whose DELIVERY is unconfirmed. sendall() returning
+        # only proves the bytes reached the kernel buffer — the link can
+        # still die with the response undelivered, and the client's
+        # reconnect-retry would then silently skip those frames. So the
+        # implicit ACK is the client's NEXT request (it only sends one
+        # after fully reading the previous response): in_flight clears at
+        # the next opcode, and a connection that dies first re-enqueues.
+        # Clean disconnects ACK explicitly with BYE; crashed clients may
+        # therefore cause duplicates (at-least-once), never silent loss.
+        in_flight: List[Any] = []
         try:
             while not self._stop.is_set():
                 op = _recv_exact(conn, 1)
+                in_flight = []  # previous response fully read (see above)
                 try:
                     if op == _OP_PUT:
                         (n,) = struct.unpack("<I", _recv_exact(conn, 4))
@@ -225,21 +244,19 @@ class TcpQueueServer:
                         if item is EMPTY:
                             conn.sendall(_ST_NO)
                         else:
-                            in_flight = [item]
+                            in_flight = [item]  # held until the next opcode
                             payload = _encode(item)
                             conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
-                            in_flight = []
                     elif op == _OP_GET_BATCH:
                         (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
                         items = queue.get_batch(min(max_items, 4096), timeout=0.0)
-                        in_flight = list(items)
+                        in_flight = list(items)  # held until the next opcode
                         parts = [_ST_OK, struct.pack("<I", len(items))]
                         for item in items:
                             payload = _encode(item)
                             parts.append(struct.pack("<I", len(payload)))
                             parts.append(payload)
                         conn.sendall(b"".join(parts))
-                        in_flight = []
                     elif op == _OP_PUT_BATCH:
                         # read the WHOLE request before touching the queue:
                         # an error mid-put (closed transport) must not leave
@@ -263,6 +280,8 @@ class TcpQueueServer:
                     elif op == _OP_CLOSE:
                         queue.close()
                         conn.sendall(_ST_OK)
+                    elif op == _OP_BYE:
+                        return  # clean goodbye: previous response is ACKed
                     elif op == _OP_OPEN:
                         (ns_len,) = struct.unpack("<H", _recv_exact(conn, 2))
                         ns = _recv_exact(conn, ns_len).decode()
@@ -283,20 +302,53 @@ class TcpQueueServer:
 
     def shutdown(self):
         self._stop.set()
+        # join the accept loop BEFORE closing: a thread blocked inside
+        # accept() keeps the listening socket alive past close(), so a
+        # supervisor rebinding the same port immediately would race it
+        # (the loop polls _stop every 0.2 s)
+        t = getattr(self, "_accept_thread", None)
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
         try:
             self._sock.close()
         except OSError:
             pass
+        # close accepted connections too: an ESTABLISHED conn keeps the
+        # port busy and would block a supervisor restarting the service on
+        # the same address (clients reconnect-with-backoff and re-dial it)
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class TcpQueueClient:
     """Client with the transport contract (put/get/size/get_wait/...).
 
-    A dead server (killed process, dropped connection) surfaces as
-    :class:`TransportClosed` from every contract method — the same signal a
-    gracefully closed queue sends — so consumers' dead-transport handling
-    (``DataReaderError``, batcher tail-flush) works for both (parity role:
-    ``RayActorError``, reference ``data_reader.py:36-37``)."""
+    Transient connection failures (network blip, server restart under a
+    supervisor) are RECONNECTED with exponential backoff and the
+    interrupted operation retried once on the fresh connection — a named
+    binding (OPEN) is replayed first, so the client lands on the same
+    (namespace, queue_name) queue. Delivery across failures is
+    AT-LEAST-ONCE, never silent loss: the server holds popped items as
+    in-flight until the client's next request implicitly acknowledges the
+    response (or BYE does, on clean disconnect), and re-enqueues them
+    when the connection dies first — so a retried GET re-reads anything
+    the dead connection had in the air, and a crashed client's unacked
+    frames go to another consumer (possibly twice; records carry
+    ``(shard_rank, event_idx)`` provenance for downstream dedup, and
+    producer PUT retries are at-least-once the same way). Only RAW socket
+    failures reconnect; an explicit server refusal (closed/draining
+    queue) is a protocol answer, not an outage.
+
+    A server that stays dead through every reconnect attempt surfaces as
+    :class:`TransportClosed` from every contract method — the same signal
+    a gracefully closed queue sends — so consumers' dead-transport
+    handling (``DataReaderError``, batcher tail-flush) works for both
+    (parity role: ``RayActorError``, reference ``data_reader.py:36-37``)."""
 
     def __init__(
         self,
@@ -306,11 +358,25 @@ class TcpQueueClient:
         namespace: Optional[str] = None,
         queue_name: Optional[str] = None,
         maxsize: int = 0,
+        reconnect_tries: int = 4,
+        reconnect_base_s: float = 0.5,
     ):
         self.host, self.port = host, port
-        self._sock = socket.create_connection((host, port), timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._timeout_s = timeout_s
+        self._reconnect_tries = reconnect_tries
+        self._reconnect_base_s = reconnect_base_s
+        self._binding: Optional[tuple] = None  # (ns, name, maxsize) to replay
         self._lock = threading.Lock()
+        # the INITIAL dial goes through the same backoff machinery as
+        # mid-stream drops: a consumer starting while the server is mid-
+        # restart under a supervisor must wait it out, not crash with a
+        # raw ConnectionRefusedError that dead-transport handlers (which
+        # catch TransportClosed) don't recognize
+        try:
+            self._sock = socket.create_connection((host, port), timeout=timeout_s)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            self._reconnect(e)  # raises TransportClosed when exhausted
         if namespace is not None or queue_name is not None:
             self.open(namespace or "default", queue_name or "default", maxsize)
 
@@ -319,35 +385,98 @@ class TcpQueueClient:
         ``(namespace, queue_name)``, get-or-creating it (``maxsize`` is
         used only on create; 0 = server default). Ray-GCS named-actor
         parity (reference ``shared_queue.py:33-38``, ``data_reader.py:20``)."""
-        ns, nm = namespace.encode(), queue_name.encode()
-        with self._lock, self._io():
-            self._sock.sendall(
-                _OP_OPEN
-                + struct.pack("<H", len(ns)) + ns
-                + struct.pack("<H", len(nm)) + nm
-                + struct.pack("<I", maxsize)
-            )
-            self._status()
+        self._binding = (namespace, queue_name, maxsize)
+        with self._lock:
+            self._retrying(lambda: self._open_raw(namespace, queue_name, maxsize))
 
-    @contextlib.contextmanager
-    def _io(self):
-        """Map raw socket failures to TransportClosed."""
+    def _open_raw(self, namespace: str, queue_name: str, maxsize: int):
+        ns, nm = namespace.encode(), queue_name.encode()
+        self._sock.sendall(
+            _OP_OPEN
+            + struct.pack("<H", len(ns)) + ns
+            + struct.pack("<H", len(nm)) + nm
+            + struct.pack("<I", maxsize)
+        )
+        self._status()
+
+    def _reconnect(self, cause: BaseException, deadline: Optional[float] = None):
+        """Re-dial with exponential backoff and replay the named binding.
+        Raises TransportClosed when every attempt fails — or when
+        ``deadline`` (time.monotonic()) passes, so timeout-bearing callers
+        (get_wait/put_wait/get_batch) keep their latency contract instead
+        of blocking through the full backoff cycle. Caller holds
+        ``self._lock`` (except from __init__, where no peer exists yet)."""
+        import time
+
+        sock = getattr(self, "_sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        delay = self._reconnect_base_s
+        last: BaseException = cause
+        for attempt in range(self._reconnect_tries):
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                break
+            if attempt:  # back off BETWEEN dials — never after the last
+                sleep_s = delay
+                if deadline is not None:
+                    sleep_s = min(sleep_s, max(0.0, deadline - now))
+                time.sleep(sleep_s)
+                delay = min(delay * 2, 5.0)
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+            dial_timeout = self._timeout_s
+            if deadline is not None:
+                dial_timeout = max(0.05, min(dial_timeout, deadline - time.monotonic()))
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=dial_timeout
+                )
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if self._binding is not None:
+                    self._open_raw(*self._binding)
+                return
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last = e
+        raise TransportClosed(
+            f"connection to queue server {self.host}:{self.port} died and "
+            f"reconnect attempts failed (tries={self._reconnect_tries}, "
+            f"deadline={'hit' if deadline is not None else 'none'}): {last}"
+        ) from last
+
+    def _retrying(self, do, deadline: Optional[float] = None):
+        """Run one request/response exchange; on a RAW socket failure,
+        reconnect (bounded by ``deadline`` when given) and retry the
+        exchange once. TransportClosed from ``_status`` (server's explicit
+        refusal) passes straight through. Caller holds ``self._lock``."""
         try:
-            yield
+            return do()
         except (ConnectionError, socket.timeout, OSError) as e:
-            raise TransportClosed(
-                f"connection to queue server {self.host}:{self.port} died: {e}"
-            ) from e
+            self._reconnect(e, deadline)  # raises TransportClosed when it can't
+            try:
+                return do()
+            except (ConnectionError, socket.timeout, OSError) as e2:
+                raise TransportClosed(
+                    f"connection to queue server {self.host}:{self.port} "
+                    f"died again right after a successful reconnect: {e2}"
+                ) from e2
 
     # -- contract ---------------------------------------------------------
-    def put(self, item: Any) -> bool:
+    def put(self, item: Any, deadline: Optional[float] = None) -> bool:
         payload = _encode(item)
-        with self._lock, self._io():
+
+        def _do():
             self._sock.sendall(_OP_PUT + struct.pack("<I", len(payload)) + payload)
             return self._status() == _ST_OK
 
-    def get(self) -> Any:
-        with self._lock, self._io():
+        with self._lock:
+            return self._retrying(_do, deadline)
+
+    def get(self, deadline: Optional[float] = None) -> Any:
+        def _do():
             self._sock.sendall(_OP_GET)
             st = self._status()
             if st == _ST_NO:
@@ -355,18 +484,28 @@ class TcpQueueClient:
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             return _decode(_recv_exact(self._sock, n))
 
+        with self._lock:
+            return self._retrying(_do, deadline)
+
     def size(self) -> int:
-        with self._lock, self._io():
+        def _do():
             self._sock.sendall(_OP_SIZE)
-            st = self._status()
+            self._status()
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             return n
 
+        with self._lock:
+            return self._retrying(_do)
+
     def close_remote(self):
         """Close the remote queue (fault-injection / teardown)."""
-        with self._lock, self._io():
+
+        def _do():
             self._sock.sendall(_OP_CLOSE)
             self._status()
+
+        with self._lock:
+            return self._retrying(_do)
 
     # -- blocking helpers (same surface as RingBuffer) --------------------
     def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.001) -> Any:
@@ -374,7 +513,7 @@ class TcpQueueClient:
 
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            item = self.get()
+            item = self.get(deadline)  # reconnects bounded by the deadline
             if item is not EMPTY:
                 return item
             if deadline is not None and time.monotonic() >= deadline:
@@ -386,7 +525,7 @@ class TcpQueueClient:
 
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            if self.put(item):
+            if self.put(item, deadline):
                 return True
             if deadline is not None and time.monotonic() >= deadline:
                 return False
@@ -399,15 +538,15 @@ class TcpQueueClient:
 
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            out = self._get_batch_once(max_items)
+            out = self._get_batch_once(max_items, deadline)
             if out:
                 return out
             if deadline is not None and time.monotonic() >= deadline:
                 return []
             time.sleep(0.001)
 
-    def _get_batch_once(self, max_items: int) -> List[Any]:
-        with self._lock, self._io():
+    def _get_batch_once(self, max_items: int, deadline: Optional[float] = None) -> List[Any]:
+        def _do():
             self._sock.sendall(_OP_GET_BATCH + struct.pack("<I", max_items))
             self._status()
             (count,) = struct.unpack("<I", _recv_exact(self._sock, 4))
@@ -417,6 +556,9 @@ class TcpQueueClient:
                 out.append(_decode(_recv_exact(self._sock, n)))
             return out
 
+        with self._lock:
+            return self._retrying(_do, deadline)
+
     def put_batch(self, items: List[Any]) -> int:
         """Send N items in ONE round trip (opcode 'Q'); returns how many
         the server accepted (a full queue truncates — retry the rest)."""
@@ -425,15 +567,31 @@ class TcpQueueClient:
         for p in payloads:
             parts.append(struct.pack("<I", len(p)))
             parts.append(p)
-        with self._lock, self._io():
-            self._sock.sendall(b"".join(parts))
+        request = b"".join(parts)
+
+        def _do():
+            self._sock.sendall(request)
             self._status()
             (accepted,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             return accepted
 
+        with self._lock:
+            return self._retrying(_do)
+
     def disconnect(self):
+        sock = getattr(self, "_sock", None)  # absent if the first dial failed
+        if sock is None:
+            return
+        # BYE acks the last response: without it the server would treat
+        # the close as a mid-delivery death and re-enqueue (duplicate) the
+        # last frame this client already consumed
         try:
-            self._sock.close()
+            with self._lock:
+                sock.sendall(_OP_BYE)
+        except OSError:
+            pass
+        try:
+            sock.close()
         except OSError:
             pass
 

@@ -270,7 +270,11 @@ class TestFlashBackward:
                 np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-5, err_msg=name
             )
 
-    def test_pallas_bwd_uneven_kv(self, rng):
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_bwd_uneven_kv(self, rng, causal):
+        # causal here exercises _tile_live tile-skipping where sk > sq:
+        # key blocks entirely beyond every query row must contribute
+        # exactly-zero dk/dv through the reset/finalize structure
         from psana_ray_tpu.parallel.flash import (
             _pallas_attention_bwd,
             _xla_attention_bwd,
@@ -280,10 +284,10 @@ class TestFlashBackward:
         q = jnp.asarray(rng.normal(size=(1, 2, 128, 128)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(1, 2, 384, 128)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(1, 2, 384, 128)).astype(np.float32))
-        o, lse = _xla_attention_with_stats(q, k, v, False)
+        o, lse = _xla_attention_with_stats(q, k, v, causal)
         do = jnp.asarray(rng.normal(size=(1, 2, 128, 128)).astype(np.float32))
-        want = _xla_attention_bwd(q, k, v, o, lse, do, False)
-        got = _pallas_attention_bwd(q, k, v, o, lse, do, False, interpret=True)
+        want = _xla_attention_bwd(q, k, v, o, lse, do, causal)
+        got = _pallas_attention_bwd(q, k, v, o, lse, do, causal, interpret=True)
         for g, w, name in zip(got, want, ("dq", "dk", "dv")):
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(w), rtol=0.0, atol=1e-4, err_msg=name

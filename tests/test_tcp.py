@@ -366,3 +366,214 @@ class TestGracefulDrain:
             assert server.depth() == 0
         finally:
             named.disconnect()
+
+
+class TestReconnect:
+    """Client-side reconnect: transient connection failures are re-dialed
+    with backoff and the interrupted exchange retried once; a server that
+    stays dead still surfaces TransportClosed."""
+
+    def test_dropped_connection_reconnects_to_live_server(self):
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        srv = TcpQueueServer(RingBuffer(8), host="127.0.0.1").serve_background()
+        try:
+            c = TcpQueueClient("127.0.0.1", srv.port, reconnect_base_s=0.05)
+            assert c.put(FrameRecord(0, 0, np.zeros((1, 2, 2), np.float32), 1.0))
+            # simulate a network drop: kill the client's socket under it
+            c._sock.close()
+            rec = c.get()  # must reconnect and serve, not raise
+            assert rec.event_idx == 0
+            c.disconnect()
+        finally:
+            srv.close_all()
+            srv.shutdown()
+
+    def test_named_binding_replayed_after_reconnect(self):
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        srv = TcpQueueServer(RingBuffer(8), host="127.0.0.1").serve_background()
+        try:
+            c = TcpQueueClient(
+                "127.0.0.1", srv.port, namespace="ns", queue_name="det_a",
+                reconnect_base_s=0.05,
+            )
+            assert c.put(FrameRecord(0, 7, np.zeros((1, 2, 2), np.float32), 1.0))
+            c._sock.close()  # drop; next op must re-dial AND re-OPEN
+            rec = c.get()
+            # lands on the same named queue (the default queue is empty;
+            # an unreplayed binding would return EMPTY here)
+            assert rec is not EMPTY and rec.event_idx == 7
+            assert srv.named_queues() == [("ns", "det_a")]
+            c.disconnect()
+        finally:
+            srv.close_all()
+            srv.shutdown()
+
+    def test_dead_server_raises_after_retries(self):
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        srv = TcpQueueServer(RingBuffer(8), host="127.0.0.1").serve_background()
+        c = TcpQueueClient(
+            "127.0.0.1", srv.port, reconnect_tries=2, reconnect_base_s=0.02,
+        )
+        srv.shutdown()  # listening socket gone: reconnects are refused
+        c._sock.close()
+        t0 = time.monotonic()
+        with pytest.raises(TransportClosed, match="reconnect attempts failed"):
+            c.get()
+        assert time.monotonic() - t0 < 10.0  # bounded, not hanging
+
+    def test_server_restart_on_same_port(self):
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        srv1 = TcpQueueServer(RingBuffer(8), host="127.0.0.1").serve_background()
+        port = srv1.port
+        c = TcpQueueClient("127.0.0.1", port, reconnect_tries=6, reconnect_base_s=0.05)
+        assert c.put(FrameRecord(0, 1, np.zeros((1, 2, 2), np.float32), 1.0))
+        srv1.shutdown()
+        c._sock.close()
+        # supervisor restarts the service on the same port (fresh queue —
+        # in-memory contents are gone; shm-backed deployments keep them)
+        srv2 = TcpQueueServer(RingBuffer(8), host="127.0.0.1", port=port).serve_background()
+        try:
+            assert c.get() is EMPTY  # reconnected to the fresh queue
+            assert c.put(FrameRecord(0, 2, np.zeros((1, 2, 2), np.float32), 1.0))
+            assert c.get().event_idx == 2
+            c.disconnect()
+        finally:
+            srv2.close_all()
+            srv2.shutdown()
+
+
+class TestDeliveryAck:
+    """At-least-once GET delivery: the server holds popped frames
+    in-flight until the client's next request (or BYE) acknowledges the
+    response, and re-enqueues them when the connection dies first."""
+
+    def _mk(self):
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueServer
+
+        q = RingBuffer(8)
+        srv = TcpQueueServer(q, host="127.0.0.1").serve_background()
+        return q, srv
+
+    def test_unacked_delivery_requeued_on_connection_death(self):
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        q, srv = self._mk()
+        try:
+            q.put(FrameRecord(0, 5, np.zeros((1, 2, 2), np.float32), 1.0))
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            rec = c.get()  # response fully read by the client...
+            assert rec.event_idx == 5 and q.size() == 0
+            c._sock.close()  # ...but the conn dies with no next request/BYE
+            deadline = time.monotonic() + 5.0
+            while q.size() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # server cannot distinguish delivered-then-died from lost:
+            # it must requeue (at-least-once — duplicate over silent loss)
+            assert q.size() == 1
+            assert q.get().event_idx == 5
+        finally:
+            srv.close_all()
+            srv.shutdown()
+
+    def test_clean_disconnect_does_not_requeue(self):
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        q, srv = self._mk()
+        try:
+            q.put(FrameRecord(0, 6, np.zeros((1, 2, 2), np.float32), 1.0))
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            assert c.get().event_idx == 6
+            c.disconnect()  # BYE acks the delivery
+            time.sleep(0.3)
+            assert q.size() == 0  # no duplicate
+        finally:
+            srv.close_all()
+            srv.shutdown()
+
+    def test_next_request_acks_previous_delivery(self):
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        q, srv = self._mk()
+        try:
+            q.put(FrameRecord(0, 7, np.zeros((1, 2, 2), np.float32), 1.0))
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            assert c.get().event_idx == 7
+            assert c.size() == 0  # any next request is the implicit ACK
+            c._sock.close()       # dying NOW must not requeue frame 7
+            time.sleep(0.3)
+            assert q.size() == 0
+        finally:
+            srv.close_all()
+            srv.shutdown()
+
+
+class TestReconnectContracts:
+    def test_initial_dial_backs_off_then_raises_transport_closed(self):
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        # nothing listening on this port: the FIRST dial must go through
+        # the backoff machinery and surface TransportClosed (which dead-
+        # transport handlers catch), not a raw ConnectionRefusedError
+        s = __import__("socket").socket()
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+        s.close()
+        with pytest.raises(TransportClosed, match="reconnect attempts failed"):
+            TcpQueueClient(
+                "127.0.0.1", free_port, reconnect_tries=2, reconnect_base_s=0.02
+            )
+
+    def test_initial_dial_waits_out_a_restarting_server(self):
+        import socket as socket_mod
+
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        srv_holder = {}
+
+        def bring_up_late():
+            time.sleep(0.3)
+            srv_holder["srv"] = TcpQueueServer(
+                RingBuffer(8), host="127.0.0.1", port=port
+            ).serve_background()
+
+        t = threading.Thread(target=bring_up_late, daemon=True)
+        t.start()
+        c = TcpQueueClient(  # dial starts before the server exists
+            "127.0.0.1", port, reconnect_tries=8, reconnect_base_s=0.1
+        )
+        assert c.size() == 0
+        c.disconnect()
+        t.join()
+        srv_holder["srv"].close_all()
+        srv_holder["srv"].shutdown()
+
+    def test_get_wait_timeout_bounds_reconnect_cycle(self):
+        from psana_ray_tpu.transport.ring import RingBuffer
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        srv = TcpQueueServer(RingBuffer(8), host="127.0.0.1").serve_background()
+        c = TcpQueueClient(
+            "127.0.0.1", srv.port,
+            reconnect_tries=10, reconnect_base_s=1.0,  # would be ~60 s unbounded
+        )
+        srv.close_all()
+        srv.shutdown()
+        c._sock.close()
+        t0 = time.monotonic()
+        with pytest.raises(TransportClosed):
+            c.get_wait(timeout=0.5)
+        assert time.monotonic() - t0 < 3.0  # deadline bounded the backoff

@@ -9,7 +9,11 @@ with device compute) -> consumer step.
 """
 
 from psana_ray_tpu.infeed.batcher import Batch, FrameBatcher  # noqa: F401
-from psana_ray_tpu.infeed.pipeline import DevicePrefetcher, InfeedPipeline  # noqa: F401
+from psana_ray_tpu.infeed.pipeline import (  # noqa: F401
+    DevicePrefetcher,
+    InfeedPipeline,
+    StopStream,
+)
 from psana_ray_tpu.infeed.multihost import (  # noqa: F401
     GlobalStreamConsumer,
     make_global_Batch,

@@ -39,7 +39,7 @@ import threading
 from typing import Any, Callable, Dict, Iterator, Mapping, Optional, Sequence, Tuple
 
 from psana_ray_tpu.infeed.batcher import Batch
-from psana_ray_tpu.infeed.pipeline import InfeedPipeline, drive_step
+from psana_ray_tpu.infeed.pipeline import InfeedPipeline, StopStream, drive_step
 from psana_ray_tpu.utils.metrics import PipelineMetrics
 
 
@@ -220,6 +220,8 @@ class FanInPipeline:
                 counts[name] += batch.num_valid
                 if on_result is not None:
                     on_result(name, out, batch)
+        except StopStream:
+            pass  # consumer-side early stop; close() below
         finally:
             self.close()
         return counts

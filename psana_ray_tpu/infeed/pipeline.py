@@ -23,6 +23,13 @@ from psana_ray_tpu.utils.metrics import PipelineMetrics
 from psana_ray_tpu.utils.trace import annotate
 
 
+class StopStream(Exception):
+    """Raise from a ``run()`` step callback to end the loop early —
+    consumer-side stop (training-step quota reached, result budget hit)
+    as opposed to the producer-side typed EOS. ``run()`` catches it,
+    closes the pipeline cleanly, and returns the count so far."""
+
+
 class DevicePrefetcher:
     """Wrap a host Batch iterator; yield device-resident batches.
 
@@ -236,6 +243,8 @@ class InfeedPipeline:
                 n += batch.num_valid
                 if on_result is not None:
                     on_result(out, batch)
+        except StopStream:
+            pass  # consumer-side early stop; close() below
         finally:
             self.close()
         return n

@@ -319,6 +319,14 @@ class TcpQueueServer:
         with self._conns_lock:
             conns, self._conns = self._conns, []
         for c in conns:
+            # SHUT_RDWR first: close() alone does not interrupt a serve
+            # thread blocked in recv() (the kernel file description stays
+            # alive), which would leave a zombie thread answering a client
+            # that should be reconnecting to the supervisor's new server
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:
@@ -387,7 +395,12 @@ class TcpQueueClient:
         parity (reference ``shared_queue.py:33-38``, ``data_reader.py:20``)."""
         self._binding = (namespace, queue_name, maxsize)
         with self._lock:
-            self._retrying(lambda: self._open_raw(namespace, queue_name, maxsize))
+            # no _retrying here: _reconnect itself replays the binding, so
+            # the usual retry-the-exchange step would send a second OPEN
+            try:
+                self._open_raw(namespace, queue_name, maxsize)
+            except (ConnectionError, socket.timeout, OSError) as e:
+                self._reconnect(e)  # raises TransportClosed when it can't
 
     def _open_raw(self, namespace: str, queue_name: str, maxsize: int):
         ns, nm = namespace.encode(), queue_name.encode()
@@ -441,10 +454,11 @@ class TcpQueueClient:
                 return
             except (ConnectionError, socket.timeout, OSError) as e:
                 last = e
+        deadline_hit = deadline is not None and time.monotonic() >= deadline
         raise TransportClosed(
             f"connection to queue server {self.host}:{self.port} died and "
-            f"reconnect attempts failed (tries={self._reconnect_tries}, "
-            f"deadline={'hit' if deadline is not None else 'none'}): {last}"
+            f"reconnect attempts failed (tries={self._reconnect_tries}"
+            f"{', caller deadline hit' if deadline_hit else ''}): {last}"
         ) from last
 
     def _retrying(self, do, deadline: Optional[float] = None):

@@ -1,0 +1,25 @@
+"""The example scripts run end to end (subprocess, CPU mesh) — they are
+the executable documentation of the streaming APIs, so they must not rot."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_train_peaknet_example_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "examples", "train_peaknet.py"),
+            "--steps", "2", "--num_events", "6", "--detector", "smoke_a",
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "trained 2 steps" in out.stdout, out.stdout[-2000:]
+    assert "mesh={'data': 2" in out.stdout, out.stdout[-500:]

@@ -352,3 +352,28 @@ class TestHostOnlyPipeline:
             FanInPipeline(
                 [DetectorStream("d", q, batch_size=2, batcher_buffers=3)]
             )
+
+
+def test_stop_stream_ends_run_early_and_closes():
+    """A step callback raising StopStream ends run() cleanly: no further
+    batches are processed, the pipeline closes, the count so far returns."""
+    from psana_ray_tpu.infeed import InfeedPipeline, StopStream
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+
+    q = RingBuffer(maxsize=64)
+    for i in range(32):
+        q.put(FrameRecord(0, i, np.zeros((1, 4, 4), np.float32), 1.0))
+    q.put(EndOfStream(total_events=32))
+
+    seen = []
+
+    def step(batch):
+        seen.append(batch.num_valid)
+        if len(seen) == 2:
+            raise StopStream
+
+    pipe = InfeedPipeline(q, batch_size=4, place_on_device=False)
+    n = pipe.run(step)
+    assert len(seen) == 2  # stopped right at the quota
+    assert n == 4  # frames counted before the stopping batch

@@ -577,3 +577,29 @@ class TestReconnectContracts:
         with pytest.raises(TransportClosed):
             c.get_wait(timeout=0.5)
         assert time.monotonic() - t0 < 3.0  # deadline bounded the backoff
+
+
+def test_server_shutdown_unblocks_idle_conns_no_zombie():
+    """shutdown() must SHUT_RDWR accepted conns: an idle client whose
+    server restarted must get a connection error -> reconnect to the NEW
+    server, not be silently answered by a zombie serve thread of the old
+    one (split-brain)."""
+    from psana_ray_tpu.transport.ring import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+    srv1 = TcpQueueServer(RingBuffer(8), host="127.0.0.1").serve_background()
+    port = srv1.port
+    c = TcpQueueClient("127.0.0.1", port, reconnect_tries=6, reconnect_base_s=0.05)
+    assert c.size() == 0
+    srv1.shutdown()  # client does NOT touch its socket — server-side only
+    srv2 = TcpQueueServer(RingBuffer(8), host="127.0.0.1", port=port).serve_background()
+    try:
+        srv2.queue.put(FrameRecord(0, 9, np.zeros((1, 2, 2), np.float32), 1.0))
+        rec = c.get_wait(timeout=10.0)
+        # only the NEW server has frame 9: receiving it proves the client
+        # re-dialed instead of talking to srv1's orphaned thread
+        assert rec is not EMPTY and rec.event_idx == 9
+        c.disconnect()
+    finally:
+        srv2.close_all()
+        srv2.shutdown()

@@ -28,6 +28,27 @@ from typing import Iterator, Optional
 logger = logging.getLogger(__name__)
 
 
+def profiler_trace_kwargs(jax) -> dict:
+    """kwargs for ``jax.profiler.start_trace`` with the python tracer OFF.
+
+    On long captures the python tracer's host events flood the trace
+    (observed hitting the xprof converter's 1M-event cap with ZERO device
+    events surviving) — the device timeline is what these traces are for.
+    Returns ``{}`` (tracer stays on, with a warning) when this jax build
+    has no ProfileOptions."""
+    try:
+        opts = jax.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        return {"profiler_options": opts}
+    except Exception as e:
+        logger.warning(
+            "jax.profiler.ProfileOptions unavailable (%r): python tracer "
+            "stays ON — long captures may flood the trace and lose the "
+            "device timeline", e,
+        )
+        return {}
+
+
 @contextlib.contextmanager
 def trace(logdir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler device trace into ``logdir``.
@@ -44,7 +65,7 @@ def trace(logdir: Optional[str]) -> Iterator[None]:
 
     path = os.path.join(logdir, time.strftime("%Y%m%d-%H%M%S"))
     try:
-        jax.profiler.start_trace(path)
+        jax.profiler.start_trace(path, **profiler_trace_kwargs(jax))
     except Exception as e:  # pragma: no cover - backend without profiler
         logger.warning("device tracing unavailable: %r", e)
         yield

@@ -45,9 +45,19 @@ PER_CHIP_TARGET_FPS = 10_000 / 16  # v5e-16 north star, per chip
 # Artifact-survival budgets (seconds). The driver kills the whole bench at
 # some unknown timeout (round 2 died at rc=124 with zero parseable output);
 # our own watchdog must always fire first, emit the current JSON, and exit 0.
-GLOBAL_BUDGET_S = float(os.environ.get("BENCH_GLOBAL_BUDGET_S", "480"))
-HEADLINE_BUDGET_S = float(os.environ.get("BENCH_HEADLINE_BUDGET_S", "180"))
-SECTION_BUDGET_S = float(os.environ.get("BENCH_SECTION_BUDGET_S", "150"))
+GLOBAL_BUDGET_S = float(os.environ.get("BENCH_GLOBAL_BUDGET_S", "720"))
+HEADLINE_BUDGET_S = float(os.environ.get("BENCH_HEADLINE_BUDGET_S", "240"))
+SECTION_BUDGET_S = float(os.environ.get("BENCH_SECTION_BUDGET_S", "240"))
+# Budget rationale: a section timeout os._exit()s the whole bench (a hung
+# C call cannot be interrupted any other way), which forfeits every LATER
+# section — so budgets carry cold-compile headroom (fused U-Net + oracle
+# + s4 compile in ~2-4 min on an empty .jax_cache); a warm full run is
+# ~8-9 min, so the global budget cannot be much tighter. The driver's own
+# kill timeout is UNKNOWN (round 2 died at rc=124): the defense there is
+# not the budget but the emission discipline — the headline prints before
+# any diagnostic and every section re-emits, so stdout's last line is a
+# complete-so-far artifact at any kill point (round 2 printed nothing
+# until the very end, which is why its timeout produced parsed=null).
 
 
 def log(msg: str):
@@ -582,12 +592,14 @@ def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras):
     else:
         seg = make_seg(lambda y: model.apply(variables, y))
         label, extras["unet_path"] = "calib+U-Net(xla)+peaks", "xla"
-    x_fresh = x_fresh_list[0]
-    n_samples = min(len(x_fresh_list), len(x_fresh) // b_unet)
-    fresh_slices = [
-        (x_fresh[k * b_unet:(k + 1) * b_unet],) for k in range(n_samples)
-    ]
-    ms = device_time_ms(jax, seg, (x_warm[:b_unet],), fresh_slices, label, extras)
+    def slices_of(b):
+        """Distinct-content b-frame slices of the fresh pool (full slices
+        only — a partial batch would skew the per-frame division)."""
+        x_fresh = x_fresh_list[0]
+        n = min(len(x_fresh_list), len(x_fresh) // b)
+        return [(x_fresh[k * b:(k + 1) * b],) for k in range(n)]
+
+    ms = device_time_ms(jax, seg, (x_warm[:b_unet],), slices_of(b_unet), label, extras)
 
     fps = b_unet / (ms / 1e3)
     extras["unet_fps"] = round(fps, 1)
@@ -606,14 +618,19 @@ def _bench_unet(jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras):
         model4 = PeakNetUNetTPU(norm="frozen", s2d=4)
         variables4 = host_init(model4, (1, 64, 64, 1))
         seg4 = make_seg(lambda y: model4.apply(variables4, y))
+        # throughput mode measures at a throughput batch: B=8 amortizes
+        # per-dispatch overheads the 5 ms B=2 dispatch can't (405 -> 521
+        # fps/chip measured), while amortized per-frame p50 stays ~2 ms
+        b4 = 8
         ms4 = device_time_ms(
-            jax, seg4, (x_warm[:b_unet],), fresh_slices, "U-Net-s4", extras
+            jax, seg4, (x_warm[:b4],), slices_of(b4), "U-Net-s4", extras
         )
-        fps4 = b_unet / (ms4 / 1e3)
+        fps4 = b4 / (ms4 / 1e3)
         extras["unet_s4_fps"] = round(fps4, 1)
+        extras["unet_s4_batch"] = b4
         log(
             f"calib+U-Net(s2d=4 throughput mode)+peaks: {ms4:.1f} ms / "
-            f"{b_unet} frames device-time -> {fps4:.1f} fps"
+            f"{b4} frames device-time -> {fps4:.1f} fps"
         )
     except Exception as e:
         log(f"U-Net s2d=4 extra skipped: {e!r}")

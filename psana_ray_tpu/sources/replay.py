@@ -17,7 +17,7 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from psana_ray_tpu.config import RetrievalMode
-from psana_ray_tpu.sources.base import DETECTORS, shard_indices
+from psana_ray_tpu.sources.base import shard_indices
 
 
 class ReplaySource:

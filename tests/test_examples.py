@@ -23,3 +23,66 @@ def test_train_peaknet_example_runs():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "trained 2 steps" in out.stdout, out.stdout[-2000:]
     assert "mesh={'data': 2" in out.stdout, out.stdout[-500:]
+
+
+def test_cli_runbook_tcp_end_to_end():
+    """The README cluster runbook, executed: queue server CLI + producer
+    CLI + consumer CLI as real subprocesses over tcp:// — the closest the
+    suite gets to the reference's 5-step bring-up (`ray start --head`,
+    mpirun producers, python consumers, `ray stop`)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    server = subprocess.Popen(
+        [sys.executable, "-m", "psana_ray_tpu.queue_server",
+         "--host", "127.0.0.1", "--port", str(port), "--queue_size", "32"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        producer = subprocess.run(
+            [sys.executable, "-m", "psana_ray_tpu.producer",
+             "--exp", "synthetic", "--num_events", "24",
+             "--detector_name", "smoke_a",
+             "--address", f"tcp://127.0.0.1:{port}",
+             "--queue_name", "q1", "--num_consumers", "1"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=180,
+        )
+        assert producer.returncode == 0, producer.stderr[-2000:]
+        consumer = subprocess.run(
+            [sys.executable, "-m", "psana_ray_tpu.consumer", "0",
+             "--address", f"tcp://127.0.0.1:{port}",
+             "--queue_name", "q1", "--quiet"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=180,
+        )
+        assert consumer.returncode == 0, consumer.stderr[-2000:]
+        out = consumer.stdout + consumer.stderr
+        # exact phrase: a bare "24" would match log timestamps
+        assert "end of stream after 24 frames" in out, out[-1500:]
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+def test_fanin_consumer_example_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "fanin_consumer.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done:" in out.stdout, out.stdout[-1500:]
+    assert "epix10k2M" in out.stdout and "jungfrau4M" in out.stdout

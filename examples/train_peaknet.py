@@ -32,7 +32,23 @@ def main():
     ap.add_argument("--detector", default="epix100")
     ap.add_argument("--num_events", type=int, default=32)
     ap.add_argument("--checkpoint_dir", default=None, help="orbax save target")
+    ap.add_argument(
+        "--norm", default="group", choices=["group", "batch"],
+        help="normalization for training: 'group' (row-independent, the "
+        "robust default) or 'batch' (running statistics — REQUIRED for "
+        "--export-serving, which folds them into the fused-inference "
+        "FrozenAffine form)",
+    )
+    ap.add_argument(
+        "--export-serving", default=None, metavar="DIR", dest="export_serving",
+        help="after training, fold BatchNorm stats into FrozenAffine "
+        "constants (models/fold.py) and save serving params here — the "
+        "parameter form peaknet_tpu_fused_infer consumes. Implies --norm "
+        "batch.",
+    )
     args = ap.parse_args()
+    if args.export_serving:
+        args.norm = "batch"
 
     from psana_ray_tpu.utils.hostmem import enable_large_alloc_reuse
 
@@ -73,7 +89,7 @@ def main():
 
     # small model so the example trains in seconds on CPU; scale features
     # to (64, 128, 256, 512) for the real PeakNet-TPU capacity
-    model = PeakNetUNetTPU(features=(16, 32), norm="group")
+    model = PeakNetUNetTPU(features=(16, 32), norm=args.norm)
 
     def labels_of(frames_nhwc):
         # stand-in ground truth: calibrated intensity over threshold.
@@ -118,6 +134,11 @@ def main():
     t0 = time.perf_counter()
 
     def train_on(batch):
+        if args.norm == "batch" and not all(batch.valid):
+            # batch statistics see every row — a padded tail would poison
+            # the running stats the serving export folds, so skip partial
+            # batches (GroupNorm training has no such constraint)
+            return None
         x, targets, row_valid = prepare(
             jnp.asarray(batch.frames), jnp.asarray(batch.valid)
         )
@@ -143,6 +164,16 @@ def main():
 
         save_train_state(args.checkpoint_dir, state)
         print(f"checkpointed to {args.checkpoint_dir}")
+
+    if args.export_serving:
+        from psana_ray_tpu.models import export_serving_params
+
+        export_serving_params(state.variables, args.export_serving)
+        print(
+            f"serving params (norm='frozen' form) exported to "
+            f"{args.export_serving} — consumable by "
+            f"PeakNetUNetTPU(norm='frozen').apply and peaknet_tpu_fused_infer"
+        )
 
 
 if __name__ == "__main__":

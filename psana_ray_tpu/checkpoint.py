@@ -116,6 +116,25 @@ def save_train_state(path: str, state) -> None:
         ckptr.wait_until_finished()
 
 
+def save_params(path: str, params) -> None:
+    """Save a plain params pytree (e.g. the serving form from
+    models/fold.fold_batchnorm); same durability contract as
+    :func:`save_train_state` (which already takes any pytree)."""
+    save_train_state(path, params)
+
+
+def load_params(path: str):
+    """Template-free restore of a params pytree saved by
+    :func:`save_params` (host numpy arrays; callers ``device_put`` or let
+    jit place them). Serving checkpoints are self-describing, so no
+    abstract template is needed."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path)
+
+
 def restore_train_state(path: str, template):
     """Restore onto the template's shardings (mesh-aware): pass a state
     built by ``create_train_state`` on the target mesh as ``template``."""

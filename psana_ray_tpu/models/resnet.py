@@ -80,6 +80,26 @@ class FrozenAffine(nn.Module):
 def _norm(dtype, features, name=None, kind: str = "group"):
     if kind == "frozen":
         return FrozenAffine(features, dtype=dtype, name=name)
+    if kind in ("batch", "batch_eval"):
+        # BatchNorm with running statistics: the TRAINABLE form whose
+        # checkpoints fold exactly into FrozenAffine for the fused serving
+        # kernels (models/fold.py) — eval-mode BatchNorm IS an affine with
+        # constants from running stats. 'batch' = training (per-batch
+        # stats, running stats updated via the mutable 'batch_stats'
+        # collection); 'batch_eval' = inference on running stats (used by
+        # the fold equivalence tests). Caveat vs GroupNorm: batch stats
+        # see every row, so train on FULL batches only (drop/skip padded
+        # tails — examples/train_peaknet.py --norm batch does).
+        return nn.BatchNorm(
+            use_running_average=(kind == "batch_eval"),
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("channels_out",)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("channels_out",)),
+            name=name,
+        )
     # aim for 32 channels/group (torchvision GroupNorm default), degrading
     # to the largest group size that divides narrow layers
     return nn.GroupNorm(

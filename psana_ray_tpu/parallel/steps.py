@@ -94,17 +94,36 @@ def make_train_step(
     makes long-sequence / deep-model training fit on chip."""
 
     def _step(state: TrainState, x: jax.Array, batch_aux) -> Tuple[TrainState, jax.Array]:
-        apply = model.apply
+        # Gradients flow to the 'params' collection only. norm='batch'
+        # models additionally carry running statistics in a mutable
+        # 'batch_stats' collection (the train→serve export form,
+        # models/fold.py): the updated stats ride back in the new state.
+        # Stats are computed on the GLOBAL (sharded) batch inside jit —
+        # XLA inserts the cross-device mean reductions, so multi-host
+        # training needs no axis_name plumbing.
+        params = state.variables["params"]
+        other = {k: v for k, v in state.variables.items() if k != "params"}
+        has_stats = "batch_stats" in other
+
+        def fwd(p, x):
+            variables = {**other, "params": p}
+            if has_stats:
+                return model.apply(variables, x, mutable=("batch_stats",))
+            return model.apply(variables, x), {}
+
         if remat:
-            apply = jax.checkpoint(apply)
+            fwd = jax.checkpoint(fwd)
 
-        def loss_of(variables):
-            logits = apply(variables, x)
-            return loss_fn(logits, batch_aux)
+        def loss_of(p):
+            logits, mutated = fwd(p, x)
+            return loss_fn(logits, batch_aux), mutated
 
-        loss, grads = jax.value_and_grad(loss_of)(state.variables)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.variables)
-        variables = optax.apply_updates(state.variables, updates)
+        (loss, mutated), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        updates, opt_state = optimizer.update(
+            {"params": grads}, state.opt_state, {"params": params}
+        )
+        params = optax.apply_updates({"params": params}, updates)["params"]
+        variables = {**other, "params": params, **mutated}
         return TrainState(variables, opt_state, state.step + 1), loss
 
     return jax.jit(_step, donate_argnums=(0,) if donate else ())
@@ -123,7 +142,10 @@ def create_train_state(
     # count) must be explicitly replicated across the mesh — left on a
     # single device, the first train step after a checkpoint restore fails
     # with "incompatible devices" (restore preserves committed shardings).
-    opt_state = jax.jit(optimizer.init)(variables)
+    # Optimizer state covers the 'params' collection only (make_train_step
+    # updates {'params': ...}); non-param collections like 'batch_stats'
+    # are carried by the train step, not the optimizer.
+    opt_state = jax.jit(optimizer.init)({"params": variables["params"]})
     replicated = NamedSharding(mesh, P())
     opt_state = jax.tree.map(
         lambda x: jax.device_put(x, replicated)

@@ -25,6 +25,41 @@ def test_train_peaknet_example_runs():
     assert "mesh={'data': 2" in out.stdout, out.stdout[-500:]
 
 
+def test_train_peaknet_export_serving(tmp_path):
+    """The train→serve continuity story end to end: --export-serving
+    trains with norm='batch', folds the running stats into the
+    FrozenAffine serving form (models/fold.py), and the exported
+    checkpoint drives both the flax norm='frozen' model and the fused
+    inference path."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    export_dir = str(tmp_path / "serving")
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "examples", "train_peaknet.py"),
+            "--steps", "2", "--num_events", "6", "--detector", "smoke_a",
+            "--export-serving", export_dir,
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "serving params" in out.stdout, out.stdout[-2000:]
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from psana_ray_tpu.checkpoint import load_params
+    from psana_ray_tpu.models import PeakNetUNetTPU
+
+    params = load_params(export_dir)
+    model = PeakNetUNetTPU(features=(16, 32), norm="frozen")
+    logits = model.apply(params, jnp.ones((1, 16, 16, 1)))
+    assert logits.shape == (1, 16, 16, 1)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
 def test_cli_runbook_tcp_end_to_end():
     """The README cluster runbook, executed: queue server CLI + producer
     CLI + consumer CLI as real subprocesses over tcp:// — the closest the

@@ -200,14 +200,15 @@ def device_time_ms(jax, fn, warm_args, fresh_args, label: str, extras=None):
     log(f"compiling {label}...")
     jax.block_until_ready(fn(*warm_args))
     tmp = tempfile.mkdtemp(prefix="bench_trace_")
-    # python tracer OFF — see utils/trace.profiler_trace_kwargs: its host
-    # events can flood the converter's cap and silently cost the
-    # device-clock number
-    from psana_ray_tpu.utils.trace import profiler_trace_kwargs
+    # python tracer OFF — see utils/trace: its host events can flood the
+    # converter's cap and silently cost the device-clock number; the
+    # helper also absorbs start_trace version skew (a TypeError here would
+    # hit the finally's stop_trace with no trace running)
+    from psana_ray_tpu.utils.trace import start_trace_python_tracer_off
 
     t0 = time.perf_counter()
     try:
-        jax.profiler.start_trace(tmp, **profiler_trace_kwargs(jax))
+        start_trace_python_tracer_off(jax, tmp)
         for args in samples:
             jax.block_until_ready(fn(*args))
     finally:

@@ -46,9 +46,24 @@ class StreamCursor:
 
     def advance(self, shard_rank: int, event_idx: int):
         r, idx = int(shard_rank), int(event_idx)
+        if not (0 <= r < self.stride):
+            raise ValueError(
+                f"shard_rank {r} outside [0, stride={self.stride}): the "
+                f"cursor's stride must equal the producer topology's "
+                f"total_shards (a mismatch would stick the watermark and "
+                f"grow the pending set without bound)"
+            )
+        if idx % self.stride != r:
+            raise ValueError(
+                f"event_idx {idx} does not belong to shard {r}'s strided "
+                f"sequence (idx % {self.stride} == {idx % self.stride}); "
+                f"wrong stride or mixed-up shard stamps"
+            )
+        cur = self.positions.get(r)
+        if cur is not None and idx <= cur:
+            return  # at-least-once duplicate of a durably-done event
         pend = self._pending.setdefault(r, set())
         pend.add(idx)
-        cur = self.positions.get(r)
         nxt = (r % self.stride) if cur is None else cur + self.stride
         while nxt in pend:
             pend.discard(nxt)

@@ -44,6 +44,14 @@ class SourceConfig:
     num_events: int = 1024
     seed: int = 0
     dtype: str = "float32"
+    # resume support (reference absent: "a restarted producer restarts the
+    # run from the beginning", SURVEY.md §5). start_event is a scalar floor
+    # applied to every shard; cursor_path points at a StreamCursor JSON
+    # (checkpoint.py) written by a consumer — on restart each shard resumes
+    # from its own contiguous watermark, re-producing anything not durably
+    # processed (at-least-once).
+    start_event: int = 0
+    cursor_path: Optional[str] = None
 
     def __post_init__(self):
         if self.mode not in RetrievalMode.ALL:

@@ -171,6 +171,27 @@ def main(argv=None):
         help="capture a jax.profiler trace of the consume loop into this "
         "directory (view in TensorBoard's Profile tab)",
     )
+    p.add_argument(
+        "--cursor_path", default=None,
+        help="persist a StreamCursor (contiguous per-shard watermark of "
+        "processed events, checkpoint.py) here; a restarted producer with "
+        "the same --cursor_path resumes past it (at-least-once). The "
+        "cursor tracks THIS consumer's progress — with multiple competing "
+        "consumers give each its own file (resuming a producer from one "
+        "consumer's cursor re-produces whatever the others handled: "
+        "duplicates, never gaps)",
+    )
+    p.add_argument(
+        "--cursor_stride", type=int, default=1,
+        help="total producer shards feeding this stream (the cursor's "
+        "watermark arithmetic needs the shard stride; must match the "
+        "producer's total_shards)",
+    )
+    p.add_argument(
+        "--cursor_save_every", type=int, default=32,
+        help="persist the cursor every N processed frames (and at exit); "
+        "<= 0 saves at exit only",
+    )
     a = p.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, a.log_level.upper(), logging.INFO),
@@ -194,21 +215,51 @@ def main(argv=None):
 
     from psana_ray_tpu.utils.trace import trace
 
+    cursor = None
+    if a.cursor_path:
+        from psana_ray_tpu.checkpoint import StreamCursor
+
+        cursor = StreamCursor.load(a.cursor_path)
+        if not cursor.positions:
+            cursor.stride = a.cursor_stride
+        elif cursor.stride != a.cursor_stride:
+            log.error(
+                "cursor %s has stride=%d but --cursor_stride=%d; refusing "
+                "(wrong stride computes wrong watermarks and can skip data)",
+                a.cursor_path, cursor.stride, a.cursor_stride,
+            )
+            return 1
+
     try:
         with trace(a.profile_dir), DataReader(
             address=a.address, queue_name=a.queue_name, namespace=a.namespace
         ) as reader:
-            for rec in reader.iter_records(stop=_should_stop):
-                n += 1
-                if not a.quiet:
-                    log.info(
-                        "consumer %d: rank=%d idx=%d shape=%s energy=%.2f",
-                        a.consumer_id, rec.shard_rank, rec.event_idx,
-                        rec.panels.shape, rec.photon_energy,
-                    )
+            try:
+                for rec in reader.iter_records(stop=_should_stop):
+                    n += 1
+                    if not a.quiet:
+                        log.info(
+                            "consumer %d: rank=%d idx=%d shape=%s energy=%.2f",
+                            a.consumer_id, rec.shard_rank, rec.event_idx,
+                            rec.panels.shape, rec.photon_energy,
+                        )
+                    if cursor is not None:
+                        # advance AFTER the record is fully handled: the
+                        # watermark must never run ahead of processing.
+                        # ValueError = stride/shard misconfiguration —
+                        # surfaced immediately, not after a wasted run
+                        cursor.advance(rec.shard_rank, rec.event_idx)
+                        if a.cursor_save_every > 0 and n % a.cursor_save_every == 0:
+                            cursor.save(a.cursor_path)
+            finally:
+                if cursor is not None:
+                    cursor.save(a.cursor_path)
         log.info("consumer %d: end of stream after %d frames", a.consumer_id, n)
     except DataReaderError as e:  # parity: psana_consumer.py:41-44
         log.error("consumer %d: queue is dead (%s); exiting", a.consumer_id, e)
+        return 1
+    except ValueError as e:  # cursor stride/shard misconfiguration
+        log.error("consumer %d: %s", a.consumer_id, e)
         return 1
     return 0
 

@@ -471,7 +471,15 @@ def flash_attention(
     Differentiable: the VJP regenerates probabilities per tile from the
     saved (q, k, v, o, lse) residuals — flash memory behavior in both
     directions, no stored score matrix (kernel shapes permitting; odd
-    shapes and non-TPU backends use the XLA formulation)."""
+    shapes and non-TPU backends use the XLA formulation).
+
+    ``causal`` uses TOP-LEFT-aligned absolute indices: q row ``i`` attends
+    k cols ``<= i``, i.e. q and k are assumed to share an origin. With
+    ``sq != sk`` this differs from FlashAttention's usual bottom-right
+    alignment — cross-attention callers whose queries are OFFSET into the
+    key sequence must bake the offset into the mask themselves (internally
+    consistent here: forward, backward, and the XLA oracle all use the
+    same ``k_index > q_index`` rule)."""
     qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     o, _ = _attention_core(qh, kh, vh, causal)
     return o.transpose(0, 2, 1, 3)

@@ -123,6 +123,7 @@ class ProducerRuntime:
         rank = self.shard_rank_offset + local_idx
         t = cfg.transport
         try:
+            start_event = self._resume_point(rank)
             source = open_source(
                 cfg.source.exp,
                 cfg.source.run,
@@ -132,7 +133,10 @@ class ProducerRuntime:
                 num_events=cfg.source.num_events,
                 seed=cfg.source.seed,
                 dtype=cfg.source.dtype,
+                start_event=start_event,
             )
+            if start_event:
+                logger.info("rank %d resuming at event >= %d", rank, start_event)
             mask = self._load_mask(source)
             backoff = BackoffPolicy(t.backoff_base_s, t.backoff_cap_s, t.backoff_jitter_s)
             sender = _Sender(
@@ -197,6 +201,30 @@ class ProducerRuntime:
                 return
         logger.info("EOS delivered to %d consumer(s)", t.num_consumers)
 
+    def _resume_point(self, rank: int) -> int:
+        """Where shard ``rank`` should (re)start: the scalar
+        ``start_event`` floor, raised to the cursor's per-shard contiguous
+        watermark when ``cursor_path`` names a consumer-written
+        :class:`~psana_ray_tpu.checkpoint.StreamCursor`. At-least-once:
+        events pending above the watermark at crash time are re-produced."""
+        cfg = self.config.source
+        start = cfg.start_event
+        if cfg.cursor_path:
+            from psana_ray_tpu.checkpoint import StreamCursor
+
+            cursor = StreamCursor.load(cfg.cursor_path)
+            if cursor.positions:
+                if cursor.stride != self.total_shards:
+                    # a mismatched stride would compute wrong per-shard
+                    # resume points and silently SKIP events — refuse
+                    raise ValueError(
+                        f"cursor {cfg.cursor_path!r} was written for "
+                        f"stride={cursor.stride} but this producer topology "
+                        f"has total_shards={self.total_shards}"
+                    )
+                start = max(start, cursor.resume_point(rank))
+        return start
+
     def _load_mask(self, source) -> Optional[np.ndarray]:
         m = self.config.mask
         mask = None
@@ -256,6 +284,17 @@ def parse_arguments(argv=None):
         "--total_shards", type=int, default=None,
         help="global shard count across all producer processes (default: auto)",
     )
+    p.add_argument(
+        "--start_event", type=int, default=0,
+        help="skip events below this index in every shard (resume floor; "
+        "the reference restarts from zero, SURVEY.md §5)",
+    )
+    p.add_argument(
+        "--cursor_path", default=None,
+        help="StreamCursor JSON written by a consumer (--cursor_path on "
+        "psana-ray-tpu-consumer): on restart each shard resumes from its "
+        "contiguous processed watermark (at-least-once)",
+    )
     a = p.parse_args(argv)
     return PipelineConfig(
         source=SourceConfig(
@@ -267,6 +306,8 @@ def parse_arguments(argv=None):
             mode=RetrievalMode.CALIB if a.calib else RetrievalMode.IMAGE,
             max_steps=a.max_steps,
             num_events=a.num_events,
+            start_event=a.start_event,
+            cursor_path=a.cursor_path,
         ),
         mask=MaskConfig(a.uses_bad_pixel_mask, a.manual_mask_path),
         transport=TransportConfig(

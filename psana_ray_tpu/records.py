@@ -191,6 +191,12 @@ class EosTally:
     protocol: duplicates are *held* (never dropped) and returned to the
     queue when space is available — re-enqueueing inline could fail against
     a full queue and silently starve the sibling.
+
+    Coverage is IDEMPOTENT per producer rank (``observe`` keys shards_done
+    by ``producer_rank``): an EOS marker duplicated by an at-least-once
+    transport retry (TCP reconnect, ``transport/tcp.py`` delivery
+    contract) cannot double-count coverage or complete a tally early —
+    the surplus copy is just held-and-returned like a sibling's.
     """
 
     def __init__(self):

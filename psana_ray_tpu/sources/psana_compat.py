@@ -5,6 +5,11 @@ Wraps the same surface the reference consumes from its external
 (exp, run, detector_name), ``iter_events(mode)``, ``create_bad_pixel_mask``.
 Import fails cleanly off-site; :func:`psana_ray_tpu.sources.open_source`
 falls back to synthetic/replay backends.
+
+Off-LCLS the adapter's contracts (damaged-event index alignment, eV→keV,
+shard striding × start_event, mask dtype) are exercised against a mock
+psana module in ``tests/test_psana_compat.py`` — the testable stand-in for
+the reference's only oracle, live beamline operation (``README.md:20``).
 """
 
 from __future__ import annotations
@@ -21,7 +26,7 @@ except ImportError as _e:  # pragma: no cover - no psana in CI
 from psana_ray_tpu.config import RetrievalMode
 
 
-class PsanaSource:  # pragma: no cover - requires LCLS environment
+class PsanaSource:
     """Shard-aware psana reader (smalldata parallel mode)."""
 
     def __init__(self, exp, run, detector_name, shard_rank=0, num_shards=1, start_event=0, **_):

@@ -11,13 +11,71 @@ or a bare ``.npy`` of frames.
 
 from __future__ import annotations
 
+import logging
 import os
+import zipfile
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
 from psana_ray_tpu.config import RetrievalMode
 from psana_ray_tpu.sources.base import shard_indices
+
+logger = logging.getLogger(__name__)
+
+
+def _mmap_npz_member(path: str, name: str) -> Optional[np.ndarray]:
+    """True mmap of an UNCOMPRESSED ``.npz`` member (``np.savez`` stores
+    members ZIP_STORED, so the inner ``.npy`` bytes sit contiguously in
+    the file): parse the zip local header + npy header to find the data
+    offset and ``np.memmap`` it. Returns None when the member is deflated
+    (``savez_compressed``) or anything about the layout surprises us —
+    callers fall back to lazy decompression."""
+    try:
+        with zipfile.ZipFile(path) as zf:
+            info = zf.getinfo(name)
+            if info.compress_type != zipfile.ZIP_STORED:
+                return None
+            with zf.open(info) as member:
+                version = np.lib.format.read_magic(member)
+                if version == (1, 0):
+                    shape, fortran, dtype = np.lib.format.read_array_header_1_0(member)
+                else:
+                    shape, fortran, dtype = np.lib.format.read_array_header_2_0(member)
+                npy_header = member.tell()
+            if fortran or dtype.hasobject:
+                return None
+        # data offset = zip local header (30 bytes + name + extra; the
+        # LOCAL extra field can differ from the central directory's, so
+        # read it from the file) + npy header
+        with open(path, "rb") as f:
+            f.seek(info.header_offset + 26)
+            name_len = int.from_bytes(f.read(2), "little")
+            extra_len = int.from_bytes(f.read(2), "little")
+        offset = info.header_offset + 30 + name_len + extra_len + npy_header
+        return np.memmap(path, dtype=dtype, mode="r", shape=shape, offset=offset)
+    except Exception as e:  # malformed/exotic archives: degrade, don't fail
+        logger.debug("npz mmap of %s[%s] unavailable: %r", path, name, e)
+        return None
+
+
+def _warn_if_exceeds_ram(path: str, name: str) -> None:
+    """Deflated members decompress fully on first access — warn when that
+    would blow physical RAM (the 86 GB replay case this source's own
+    docstring cites) and point at the .npy / uncompressed-savez fix."""
+    try:
+        with zipfile.ZipFile(path) as zf:
+            nbytes = zf.getinfo(name).file_size
+        avail = os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, KeyError):
+        return
+    if nbytes > 0.8 * avail:
+        logger.warning(
+            "replay member %s[%s] is %.1f GB but only %.1f GB RAM is free; "
+            "it will decompress fully on first access. Record with np.savez "
+            "(uncompressed, mmap-able) or a bare .npy for >RAM runs.",
+            path, name, nbytes / 1e9, avail / 1e9,
+        )
 
 
 class ReplaySource:
@@ -38,11 +96,16 @@ class ReplaySource:
         self.num_shards = num_shards
         self.start_event = start_event
         if path.endswith(".npz"):
-            # npz members decompress lazily on first access; frames stay
-            # backed by the zip until indexed (still one big array on use —
-            # for runs larger than RAM, record as .npy and get true mmap).
             z = np.load(path)
-            self._frames = z["frames"]
+            # uncompressed members (np.savez default) get a TRUE mmap: a
+            # shard touches only its strided events, never the full array
+            frames = _mmap_npz_member(path, "frames.npy")
+            if frames is None:
+                # deflated (savez_compressed): decompresses fully on first
+                # access — warn when that exceeds free RAM
+                _warn_if_exceeds_ram(path, "frames.npy")
+                frames = z["frames"]
+            self._frames = frames
             self._energy = z["photon_energy"] if "photon_energy" in z else None
             self._mask = z["bad_pixel_mask"] if "bad_pixel_mask" in z else None
         else:

@@ -15,10 +15,33 @@ Wire protocol (all little-endian):
               'Q' (put-batch) + count:u32 + count x (len:u32 + payload)
               'O' (open) + ns_len:u16 + ns + name_len:u16 + name
                          + maxsize:u32
+              'F' (bye) — no response; acks the last delivery and ends
+                  the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
               + [G ok] len:u32 + payload   + [S] size:u32
               + [B ok] count:u32 + count x (len:u32 + payload)
               + [Q ok] accepted:u32
+
+Delivery contract (PART OF THE WIRE PROTOCOL, not a server detail): the
+server holds each GET/B delivery as in-flight until the SAME connection's
+next opcode arrives (implicit ACK — a client can only send its next
+request after fully reading the previous response) or BYE acks it on
+clean disconnect. This assumes ONE outstanding request per connection: a
+pipelining client that sends request N+1 before reading response N would
+silently forfeit in-flight protection (the early opcode acks a delivery
+the client has not read). Duplicates are therefore possible on crash/
+retry (at-least-once), silent loss is not. Duplicated control records are
+benign: EndOfStream markers tally idempotently (coverage is keyed by
+``producer_rank`` — :class:`psana_ray_tpu.records.EosTally`), and
+FrameRecord duplicates carry their ``(shard_rank, event_idx)`` provenance
+for downstream dedup.
+
+Client threading: :class:`TcpQueueClient` serializes every exchange under
+one lock, satisfying the one-outstanding-request rule; during an outage a
+reconnecting call holds that lock through the backoff cycle, so OTHER
+threads sharing the client (e.g. a monitor calling ``size()``) block for
+up to the full reconnect envelope — use one client per thread where that
+matters.
 
 The batch opcodes exist so a cross-host consumer drains N records per
 round trip instead of reintroducing the reference's one-RPC-per-event

@@ -49,6 +49,26 @@ def profiler_trace_kwargs(jax) -> dict:
         return {}
 
 
+def start_trace_python_tracer_off(jax, path: str) -> None:
+    """``jax.profiler.start_trace`` with the python tracer disabled when
+    possible. Guards the VERSION-SKEW case ProfileOptions construction
+    alone cannot: a jax whose ProfileOptions exists but whose start_trace
+    lacks the ``profiler_options`` kwarg raises TypeError — retry without
+    the kwarg instead of letting it escape into callers' finally-blocks
+    (where a stop_trace on a never-started trace masks the real error)."""
+    kwargs = profiler_trace_kwargs(jax)
+    try:
+        jax.profiler.start_trace(path, **kwargs)
+    except TypeError:
+        if not kwargs:
+            raise
+        logger.warning(
+            "start_trace rejected profiler_options (version skew): python "
+            "tracer stays ON for this capture"
+        )
+        jax.profiler.start_trace(path)
+
+
 @contextlib.contextmanager
 def trace(logdir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler device trace into ``logdir``.
@@ -65,7 +85,7 @@ def trace(logdir: Optional[str]) -> Iterator[None]:
 
     path = os.path.join(logdir, time.strftime("%Y%m%d-%H%M%S"))
     try:
-        jax.profiler.start_trace(path, **profiler_trace_kwargs(jax))
+        start_trace_python_tracer_off(jax, path)
     except Exception as e:  # pragma: no cover - backend without profiler
         logger.warning("device tracing unavailable: %r", e)
         yield

@@ -54,12 +54,12 @@ class TestStreamCursor:
     def test_save_load_roundtrip(self, tmp_path):
         c = StreamCursor()
         for i in range(42):
-            c.advance(3, i)
-        c.advance(3, 50)  # pending — must NOT survive the roundtrip
+            c.advance(0, i)
+        c.advance(0, 50)  # pending — must NOT survive the roundtrip
         path = str(tmp_path / "run.cursor")
         c.save(path)
         c2 = StreamCursor.load(path)
-        assert c2.resume_point(3) == 42  # at-least-once: 50 will re-run
+        assert c2.resume_point(0) == 42  # at-least-once: 50 will re-run
 
     def test_load_legacy_format(self, tmp_path):
         import json
@@ -108,3 +108,35 @@ class TestTrainStateCheckpoint:
         k = restored.variables["params"]["stem"]["kernel"]
         assert k.sharding.spec[-1] == "model"
         assert int(restored.step) == int(state.step)
+
+
+class TestCursorDuplicateAndMisconfigGuards:
+    """Round-4 review findings: at-least-once duplicates below the
+    watermark must not leak into the pending set, and stride/shard
+    misconfigurations must fail at advance time, not stick silently."""
+
+    def test_duplicate_below_watermark_does_not_leak_pending(self):
+        from psana_ray_tpu.checkpoint import StreamCursor
+
+        c = StreamCursor(stride=1)
+        for i in range(5):
+            c.advance(0, i)
+        assert c.positions[0] == 4 and c.pending_count(0) == 0
+        for i in range(5):  # TCP-retry style redelivery of done events
+            c.advance(0, i)
+        assert c.positions[0] == 4
+        assert c.pending_count(0) == 0  # no unbounded growth
+
+    def test_rank_outside_stride_raises(self):
+        from psana_ray_tpu.checkpoint import StreamCursor
+
+        c = StreamCursor(stride=2)
+        with pytest.raises(ValueError, match="outside"):
+            c.advance(3, 3)
+
+    def test_misaligned_idx_raises(self):
+        from psana_ray_tpu.checkpoint import StreamCursor
+
+        c = StreamCursor(stride=4)
+        with pytest.raises(ValueError, match="strided sequence"):
+            c.advance(1, 2)  # shard 1 of 4 owns 1, 5, 9, ...

@@ -1,0 +1,164 @@
+"""Contract tests for the psana adapter against a mock psana module.
+
+The reference's only oracle for this surface was live LCLS operation
+(reference ``README.md:20``); off-site, the testable equivalent is a fake
+``psana`` exercising the adapter's contracts: damaged-event None handling
+must consume the event index (reference parity: ``producer.py:88`` counts
+a local idx; ours must stay globally aligned), eV→keV conversion, missing
+ebeam readings, shard striding × ``start_event`` interplay, and mask dtype.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _FakeRaw:
+    """det.raw facade: calib/image/raw per event + bad-pixel mask."""
+
+    def __init__(self, frames, damaged):
+        self.frames = frames  # event_idx -> array
+        self.damaged = set(damaged)
+
+    def calib(self, evt):
+        return None if evt.idx in self.damaged else self.frames[evt.idx]
+
+    def image(self, evt):
+        f = self.calib(evt)
+        return None if f is None else f.sum(axis=0)  # assembled 2-D stand-in
+
+    def raw(self, evt):
+        return self.calib(evt)
+
+    def mask(self, calib_const=True, status=True):
+        assert calib_const and status  # the adapter requests both sources
+        m = np.ones(self.frames[0].shape, dtype=bool)
+        m[..., 0] = False
+        return m
+
+
+class _FakeEbeamRaw:
+    def __init__(self, energies_ev):
+        self.energies_ev = energies_ev
+
+    def ebeamPhotonEnergy(self, evt):
+        return self.energies_ev.get(evt.idx)  # None when the reading is absent
+
+
+class _Evt:
+    def __init__(self, idx):
+        self.idx = idx
+
+
+class _FakeRun:
+    def __init__(self, frames, damaged, energies_ev):
+        self._frames, self._damaged, self._energies = frames, damaged, energies_ev
+
+    def Detector(self, name):
+        det = types.SimpleNamespace()
+        det.raw = (
+            _FakeEbeamRaw(self._energies)
+            if name == "ebeam"
+            else _FakeRaw(self._frames, self._damaged)
+        )
+        return det
+
+    def events(self):
+        return iter(_Evt(i) for i in range(len(self._frames)))
+
+
+def _install_fake_psana(monkeypatch, n_events=12, damaged=(), energies_ev=None):
+    frames = [
+        np.full((2, 4, 4), float(i), dtype=np.float64) for i in range(n_events)
+    ]
+    energies = energies_ev if energies_ev is not None else {
+        i: 9500.0 + i for i in range(n_events)
+    }
+
+    fake = types.ModuleType("psana")
+
+    def DataSource(exp=None, run=None):
+        ds = types.SimpleNamespace()
+        ds.runs = lambda: iter([_FakeRun(frames, damaged, energies)])
+        return ds
+
+    fake.DataSource = DataSource
+    monkeypatch.setitem(sys.modules, "psana", fake)
+    # fresh import under the fake (a real psana would have failed at import)
+    monkeypatch.delitem(sys.modules, "psana_ray_tpu.sources.psana_compat", raising=False)
+    from psana_ray_tpu.sources.psana_compat import PsanaSource
+
+    return PsanaSource
+
+
+class TestPsanaContract:
+    def test_indices_are_global_and_energy_is_kev(self, monkeypatch):
+        PsanaSource = _install_fake_psana(monkeypatch, n_events=6)
+        src = PsanaSource("mfxl1038923", 58, "epix10k2M")
+        out = list(src.iter_indexed_events("calib"))
+        assert [i for i, _, _ in out] == [0, 1, 2, 3, 4, 5]
+        # eV reading / 1000 -> keV (reference units: photon_energy in keV)
+        assert out[0][2] == pytest.approx(9.5)
+        assert out[5][2] == pytest.approx(9.505)
+        # frames come back float32 regardless of psana's float64
+        assert all(d.dtype == np.float32 for _, d, _ in out)
+
+    def test_damaged_event_consumes_index_but_is_skipped(self, monkeypatch):
+        PsanaSource = _install_fake_psana(monkeypatch, n_events=6, damaged=(2, 3))
+        src = PsanaSource("x", 1, "det")
+        idxs = [i for i, _, _ in src.iter_indexed_events("calib")]
+        # 2 and 3 are gone but LATER indices are unshifted — the global
+        # event number is the resume/provenance key, so a damaged event
+        # must not renumber the stream
+        assert idxs == [0, 1, 4, 5]
+
+    def test_missing_ebeam_reading_maps_to_zero(self, monkeypatch):
+        PsanaSource = _install_fake_psana(
+            monkeypatch, n_events=2, energies_ev={0: None, 1: 8000.0}
+        )
+        src = PsanaSource("x", 1, "det")
+        out = list(src.iter_indexed_events("calib"))
+        assert out[0][2] == 0.0
+        assert out[1][2] == pytest.approx(8.0)
+
+    def test_shard_striding_with_damage(self, monkeypatch):
+        PsanaSource = _install_fake_psana(monkeypatch, n_events=10, damaged=(3,))
+        a = PsanaSource("x", 1, "det", shard_rank=0, num_shards=2)
+        b = PsanaSource("x", 1, "det", shard_rank=1, num_shards=2)
+        ia = [i for i, _, _ in a.iter_indexed_events("calib")]
+        ib = [i for i, _, _ in b.iter_indexed_events("calib")]
+        assert ia == [0, 2, 4, 6, 8]
+        assert ib == [1, 5, 7, 9]  # 3 damaged: skipped, not renumbered
+        assert not set(ia) & set(ib)  # disjoint shards
+
+    def test_start_event_composes_with_sharding(self, monkeypatch):
+        PsanaSource = _install_fake_psana(monkeypatch, n_events=12)
+        src = PsanaSource("x", 1, "det", shard_rank=1, num_shards=3, start_event=5)
+        idxs = [i for i, _, _ in src.iter_indexed_events("calib")]
+        # shard 1 of 3 owns 1, 4, 7, 10; start_event=5 keeps >= 5
+        assert idxs == [7, 10]
+
+    def test_image_mode_and_raw_mode_dispatch(self, monkeypatch):
+        PsanaSource = _install_fake_psana(monkeypatch, n_events=2)
+        src = PsanaSource("x", 1, "det")
+        img = next(iter(src.iter_indexed_events("image")))[1]
+        assert img.ndim == 2  # assembled image, not a panel stack
+        rawd = next(iter(src.iter_indexed_events("raw")))[1]
+        assert rawd.ndim == 3
+
+    def test_bad_pixel_mask_is_uint8(self, monkeypatch):
+        PsanaSource = _install_fake_psana(monkeypatch)
+        src = PsanaSource("x", 1, "det")
+        mask = src.create_bad_pixel_mask()
+        assert mask.dtype == np.uint8
+        assert mask.shape == (2, 4, 4)
+        assert mask[..., 0].max() == 0 and mask[..., 1].min() == 1
+
+    def test_open_source_dispatches_to_psana_backend(self, monkeypatch):
+        _install_fake_psana(monkeypatch, n_events=4)
+        from psana_ray_tpu.sources import open_source
+
+        src = open_source("mfxl1038923", 58, "epix10k2M", shard_rank=0, num_shards=1)
+        assert [i for i, _, _ in src.iter_indexed_events("calib")] == [0, 1, 2, 3]

@@ -103,6 +103,19 @@ class TestEosAggregation:
         assert t.is_duplicate(eos)
         assert not t.is_duplicate(EndOfStream(producer_rank=1, shards_done=1, total_shards=2))
 
+    def test_tally_idempotent_under_at_least_once_duplicates(self):
+        """A transport retry (TCP reconnect) can duplicate an EOS marker;
+        coverage is keyed by producer_rank, so N duplicated copies from
+        one runtime must never complete the tally in place of the missing
+        runtime's marker (tcp.py delivery contract)."""
+        t = EosTally()
+        eos_a = EndOfStream(producer_rank=0, shards_done=1, total_shards=2)
+        assert not t.observe(eos_a)
+        for _ in range(3):  # duplicated deliveries of the SAME marker
+            assert not t.process(eos_a)
+            assert not t.complete
+        assert t.observe(EndOfStream(producer_rank=1, shards_done=1, total_shards=2))
+
 
 class TestZeroCopyCodec:
     """encode_into/encoded_size must produce byte-identical wire data to

@@ -1,0 +1,158 @@
+"""CLI-reachable resume: kill a producer+consumer mid-stream, restart both
+from the consumer-written StreamCursor, and verify every event is processed
+at-least-once with no gap.
+
+The reference loses all position on restart (its ``iter_events`` has no
+cursor, reference ``producer.py:88``; SURVEY.md §5 "a restarted producer
+restarts the run from the beginning"). Here the consumer CLI persists a
+contiguous per-shard watermark (``--cursor_path``) and the producer CLI
+resumes past it (``--cursor_path`` / ``--start_event``).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_EVENTS = 200
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _start_server(port, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "psana_ray_tpu.queue_server",
+         "--host", "127.0.0.1", "--port", str(port), "--queue_size", "64"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _producer_cmd(port, cursor):
+    return [
+        sys.executable, "-m", "psana_ray_tpu.producer",
+        "--exp", "synthetic", "--num_events", str(N_EVENTS),
+        "--detector_name", "smoke_a",
+        "--address", f"tcp://127.0.0.1:{port}",
+        "--queue_name", "rq", "--num_consumers", "1",
+        "--cursor_path", cursor,
+    ]
+
+
+def _consumer_cmd(port, cursor):
+    return [
+        sys.executable, "-m", "psana_ray_tpu.consumer", "0",
+        "--address", f"tcp://127.0.0.1:{port}",
+        "--queue_name", "rq",
+        "--cursor_path", cursor, "--cursor_save_every", "1",
+    ]
+
+
+def _processed_indices(text):
+    out = set()
+    for line in text.splitlines():
+        if "idx=" in line and "rank=" in line:
+            out.add(int(line.split("idx=")[1].split()[0]))
+    return out
+
+
+def test_kill_and_resume_covers_every_event(tmp_path):
+    env = _env()
+    cursor = str(tmp_path / "stream.cursor.json")
+    out1_path = tmp_path / "consumer1.out"
+
+    # --- run 1: full stream launched, both sides SIGKILLed mid-flight ----
+    port1 = _free_port()
+    server1 = _start_server(port1, env)
+    producer1 = consumer1 = None
+    try:
+        producer1 = subprocess.Popen(
+            _producer_cmd(port1, cursor), env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        with open(out1_path, "w") as f1:
+            consumer1 = subprocess.Popen(
+                _consumer_cmd(port1, cursor), env=env, cwd=REPO,
+                stdout=f1, stderr=subprocess.STDOUT, text=True,
+            )
+            # wait for real mid-stream progress (watermark >= 20), then
+            # SIGKILL producer and consumer — no graceful teardown
+            deadline = time.monotonic() + 120
+            watermark = -1
+            while time.monotonic() < deadline:
+                if os.path.exists(cursor):
+                    with open(cursor) as f:
+                        pos = json.load(f).get("positions", {})
+                    watermark = int(pos.get("0", -1))
+                    if watermark >= 20:
+                        break
+                time.sleep(0.02)
+            assert watermark >= 20, f"no mid-stream progress (watermark={watermark})"
+            # the stream must still be live — killing after completion
+            # would test nothing
+            assert producer1.poll() is None or watermark < N_EVENTS - 1
+            producer1.kill()
+            consumer1.kill()
+            producer1.wait(timeout=30)
+            consumer1.wait(timeout=30)
+    finally:
+        for proc in (producer1, consumer1):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        server1.kill()
+        server1.wait(timeout=15)
+
+    done1 = _processed_indices(out1_path.read_text())
+    assert done1, "consumer 1 processed nothing"
+    with open(cursor) as f:
+        saved = json.load(f)
+    resume_at = int(saved["positions"]["0"]) + 1
+    assert 20 <= resume_at <= len(done1) + 1  # contiguous watermark semantics
+
+    # --- run 2: fresh server, both sides restarted from the cursor -------
+    port2 = _free_port()
+    server2 = _start_server(port2, env)
+    try:
+        producer2 = subprocess.Popen(
+            _producer_cmd(port2, cursor), env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        consumer2 = subprocess.run(
+            _consumer_cmd(port2, cursor), env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=300,
+        )
+        p_out, _ = producer2.communicate(timeout=120)
+        assert producer2.returncode == 0, p_out[-2000:]
+        assert consumer2.returncode == 0, consumer2.stderr[-2000:]
+        assert f"resuming at event >= {resume_at}" in p_out, p_out[-1500:]
+    finally:
+        server2.kill()
+        server2.wait(timeout=15)
+
+    done2 = _processed_indices(consumer2.stdout + consumer2.stderr)
+    # at-least-once, no gap: the union covers every event exactly
+    assert done1 | done2 == set(range(N_EVENTS)), (
+        f"gap: missing {sorted(set(range(N_EVENTS)) - (done1 | done2))[:10]}"
+    )
+    # run 2 really resumed (started from the watermark, not from zero)
+    assert min(done2) == resume_at
+    # and the final cursor covers the whole stream
+    with open(cursor) as f:
+        assert int(json.load(f)["positions"]["0"]) == N_EVENTS - 1

@@ -108,3 +108,33 @@ def test_open_source_dispatch(tmp_path):
     assert isinstance(open_source(f"replay:{tmp_path}/x.npy", 1, "epix100"), ReplaySource)
     with pytest.raises(RuntimeError, match="psana"):
         open_source("mfxl1038923", 58, "epix10k2M")
+
+
+def test_replay_npz_uncompressed_is_true_mmap(tmp_path):
+    """np.savez members are ZIP_STORED: the replay source must map them
+    directly (no whole-member decompression — the 86 GB >RAM replay case)."""
+    frames = np.random.default_rng(1).random((5, 2, 8, 8)).astype(np.float32)
+    path = tmp_path / "big.npz"
+    np.savez(path, frames=frames, photon_energy=np.full(5, 9.5))
+    src = ReplaySource(str(path))
+    import mmap as _mmap
+
+    arr = src._frames
+    while getattr(arr, "base", None) is not None and not isinstance(arr, _mmap.mmap):
+        if isinstance(arr, np.memmap):
+            break
+        arr = arr.base
+    assert isinstance(arr, (np.memmap, _mmap.mmap)), type(arr)
+    events = list(src.iter_events())
+    assert len(events) == 5
+    np.testing.assert_array_equal(events[3][0], frames[3])
+
+
+def test_replay_npz_compressed_still_works(tmp_path):
+    frames = np.random.default_rng(2).random((4, 1, 4, 4)).astype(np.float32)
+    path = tmp_path / "c.npz"
+    np.savez_compressed(path, frames=frames)
+    src = ReplaySource(str(path))
+    events = list(src.iter_events())
+    assert len(events) == 4
+    np.testing.assert_array_equal(events[1][0], frames[1])

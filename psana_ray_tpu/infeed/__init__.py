@@ -16,6 +16,7 @@ from psana_ray_tpu.infeed.pipeline import (  # noqa: F401
 )
 from psana_ray_tpu.infeed.multihost import (  # noqa: F401
     GlobalStreamConsumer,
+    MultiDetectorGlobalConsumer,
     make_global_Batch,
     make_global_batch,
 )

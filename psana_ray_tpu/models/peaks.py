@@ -76,6 +76,72 @@ def find_peaks(
     return yx, jnp.where(valid, score, 0.0), valid.sum(axis=1).astype(jnp.int32)
 
 
+def peak_metrics(
+    pred_yx: np.ndarray,
+    pred_n: np.ndarray,
+    truth: Sequence[np.ndarray],
+    tolerance: float = 3.0,
+    min_amplitude: float = 0.0,
+) -> dict:
+    """Recall / precision of predicted peaks against planted ground truth.
+
+    ``pred_yx [N, max_peaks, 2]`` / ``pred_n [N]`` are :func:`find_peaks`
+    outputs in panel-as-batch layout (row i = one panel); ``truth`` is one
+    ``[n, 4]`` array of ``(panel, cy, cx, amplitude)`` rows PER PANEL-ROW
+    of the predictions (pre-split by panel — see
+    ``SyntheticSource.event_with_truth`` for the per-event form).
+
+    Greedy one-to-one matching: each truth peak claims the nearest
+    still-unclaimed prediction within ``tolerance`` pixels. ``recall`` =
+    matched truth / truth, ``precision`` = matched predictions /
+    predictions. ``min_amplitude`` drops truth peaks too weak for the
+    label policy under evaluation (sub-threshold plants are unknowable to
+    a model trained on thresholded labels); predictions that land on an
+    IGNORED plant are excluded from the precision denominator too — a
+    correct detection of a weak plant is neither a hit nor a false
+    positive (the standard ignore-region convention of detection
+    metrics)."""
+
+    def _claim(centers, preds, taken):
+        claimed = 0
+        for cy, cx in centers:
+            d = np.hypot(preds[:, 0] - cy, preds[:, 1] - cx)
+            d[taken] = np.inf
+            j = int(np.argmin(d))
+            if d[j] <= tolerance:
+                taken[j] = True
+                claimed += 1
+        return claimed
+
+    n_truth = n_matched = n_pred = 0
+    for i, t in enumerate(truth):
+        k = int(pred_n[i])
+        preds = np.asarray(pred_yx[i][:k], np.float32)
+        t = np.asarray(t, np.float32).reshape(-1, 4)
+        scored = t[:, 3] >= min_amplitude
+        n_truth += int(scored.sum())
+        if k == 0:
+            continue
+        taken = np.zeros(k, bool)
+        n_matched += _claim(t[scored][:, 1:3], preds, taken)
+        ignored_claims = _claim(t[~scored][:, 1:3], preds, taken)
+        n_pred += k - ignored_claims
+    return {
+        "recall": n_matched / max(n_truth, 1),
+        "precision": n_matched / max(n_pred, 1),
+        "n_truth": n_truth,
+        "n_pred": n_pred,
+        "n_matched": n_matched,
+    }
+
+
+def split_truth_by_panel(truth: np.ndarray, n_panels: int) -> list:
+    """One event's ``[n, 4] (panel, cy, cx, amp)`` truth -> per-panel list
+    (panel-as-batch layout, matching ``panels_to_nhwc(.., 'batch')``)."""
+    truth = np.asarray(truth, np.float32).reshape(-1, 4)
+    return [truth[truth[:, 0] == p] for p in range(n_panels)]
+
+
 @dataclasses.dataclass
 class PeakSet:
     """Host-side peak list for one event (unpadded)."""

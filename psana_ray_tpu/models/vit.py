@@ -1,0 +1,125 @@
+"""ViT-style diffraction hit classifier — the sequence-parallel consumer.
+
+The reference's consumers are opaque per-GPU torch loops (SURVEY.md §2);
+the task spec makes long-context sequence parallelism first-class for the
+TPU build. This model is the workload that EXERCISES that stack end to
+end: a detector frame becomes one long token sequence (every panel
+patchified and concatenated — epix10k2M at 16x16 patches is 16 panels x
+22x24 = 8,448 tokens), and the attention trunk runs through a pluggable
+attention function, so the SAME model serves:
+
+- single-chip: :func:`psana_ray_tpu.parallel.flash.flash_attention`
+  (Pallas flash kernel; head_dim defaults to 128 so the kernel's shape
+  constraints are met on real detector geometries);
+- sequence-parallel over a ('data', 'seq') mesh:
+  ``functools.partial(ulysses_attention, mesh=mesh, seq_axis='seq',
+  data_axis='data', impl='flash')`` — all-to-all re-shards tokens to
+  heads, each device runs full-sequence flash on H/P heads, and the
+  second all-to-all restores the token sharding
+  (:func:`psana_ray_tpu.parallel.ring_attention.ulysses_attention`);
+- ring layout: :func:`psana_ray_tpu.parallel.flash.ring_flash_attention`
+  (K/V rotate over ICI; trainable since round 4).
+
+Attention here is NON-causal (a frame's patches have no temporal order);
+LayerNorm (per-token, batch-independent) needs no train→serve folding.
+bf16 compute / f32 params, f32 logits — same conventions as the conv
+models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+def patchify_panels(frames: jax.Array, patch: int) -> jax.Array:
+    """``[B, P, H, W] -> [B, P*(H/p)*(W/p), p*p]`` — every panel cut into
+    non-overlapping p x p patches, flattened to one token sequence (panel
+    tokens concatenated in panel order; an exact relayout, no compute)."""
+    b, p, h, w = frames.shape
+    if h % patch or w % patch:
+        raise ValueError(
+            f"patchify needs H, W divisible by patch={patch}; got {h}x{w}"
+        )
+    th, tw = h // patch, w // patch
+    x = frames.reshape(b, p, th, patch, tw, patch)
+    x = x.transpose(0, 1, 2, 4, 3, 5)  # [B, P, th, tw, patch, patch]
+    return x.reshape(b, p * th * tw, patch * patch)
+
+
+class TransformerBlock(nn.Module):
+    embed_dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None  # (q, k, v) -> o, [B, S, H, D]
+
+    @nn.compact
+    def __call__(self, x):
+        from psana_ray_tpu.parallel.flash import flash_attention
+
+        attn = self.attn_fn or (lambda q, k, v: flash_attention(q, k, v))
+        b, s, e = x.shape
+        h = self.num_heads
+        d = e // h
+
+        # pre-LN attention
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * e, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32, name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
+        o = attn(q, k, v).reshape(b, s, e)
+        x = x + nn.Dense(e, use_bias=False, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="proj")(o)
+
+        # pre-LN MLP
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="up")(y)
+        y = nn.gelu(y)
+        return x + nn.Dense(e, dtype=self.dtype, param_dtype=jnp.float32,
+                            name="down")(y)
+
+
+class ViTHitClassifier(nn.Module):
+    """``[B, P, H, W] panel stack -> [B, num_classes]`` hit/miss logits.
+
+    ``attn_fn`` is the pluggable attention (see module docstring); the
+    default single-device flash path needs no mesh. ``embed_dim /
+    num_heads`` defaults to head_dim 128 so real-geometry serving hits
+    the Pallas flash kernel's shape constraints (D % 128 == 0)."""
+
+    patch: int = 16
+    embed_dim: int = 512
+    depth: int = 4
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    num_classes: int = 2
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, frames):
+        x = patchify_panels(frames.astype(self.dtype), self.patch)
+        x = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="embed")(x)
+        s = x.shape[1]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, s, self.embed_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                self.embed_dim, self.num_heads, self.mlp_ratio,
+                dtype=self.dtype, attn_fn=self.attn_fn,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=1)  # token mean-pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)

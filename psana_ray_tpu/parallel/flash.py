@@ -29,12 +29,15 @@ Layouts match ring_attention.py: global ``[B, S, H, D]`` sharded
 
 Differentiability: :func:`flash_attention` carries a full flash VJP
 (backward kernels regenerate probability tiles from the saved row
-log-sum-exp — no stored score matrix in either direction), which also
-powers ``ulysses_attention(impl='flash')`` for long-context TRAINING.
-The stats-returning :func:`attention_with_stats` and the hop-combining
-:func:`ring_flash_attention` remain forward-only serving paths (their
-lse outputs would need their own cotangent handling); use
-:func:`ring_attention` for training a ring layout.
+log-sum-exp — no stored score matrix in either direction), which powers
+``ulysses_attention(impl='flash')`` for long-context training. The
+stats-returning :func:`attention_with_stats` is ALSO differentiable —
+its lse cotangent folds into the backward's delta term (∂lse/∂s = p), so
+the same two backward kernels serve it — which makes the hop-combining
+:func:`ring_flash_attention` trainable end to end: gradients flow through
+the LSE renormalization, the ``lax.switch`` causal hop structure, the
+``fori_loop`` rotation (static trip count → scan), and the ``ppermute``
+(whose transpose is the reverse rotation).
 """
 
 from __future__ import annotations
@@ -333,9 +336,14 @@ def _flash_bwd_dq_kernel(
 
 
 def _pallas_attention_bwd(
-    q, k, v, o, lse, do, causal: bool, interpret: bool = False
+    q, k, v, o, lse, do, causal: bool, interpret: bool = False, dlse=None
 ):
-    """[B,H,S,D] flash backward; returns (dq, dk, dv) in the input dtypes."""
+    """[B,H,S,D] flash backward; returns (dq, dk, dv) in the input dtypes.
+
+    ``dlse`` (optional, [B,H,Sq] f32) is the cotangent of the row
+    log-sum-exp output. Since ∂lse_i/∂s_ij = p_ij, it enters the softmax
+    Jacobian as ``ds = p·(dp − delta + dlse)·scale`` — algebraically just
+    ``delta → delta − dlse``, so the kernels need no changes at all."""
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
@@ -343,6 +351,8 @@ def _pallas_attention_bwd(
     bh = b * h
     sm_scale = d**-0.5
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qf, kf, vf = (x.reshape(bh, -1, d) for x in (q, k, v))
     dof = do.reshape(bh, sq, d)
@@ -398,9 +408,10 @@ def _pallas_attention_bwd(
     )
 
 
-def _xla_attention_bwd(q, k, v, o, lse, do, causal: bool):
+def _xla_attention_bwd(q, k, v, o, lse, do, causal: bool, dlse=None):
     """Reference backward from the same residuals (normalized p from lse);
-    used off-TPU and for odd shapes — materializes the score matrix."""
+    used off-TPU and for odd shapes — materializes the score matrix.
+    ``dlse`` folds into delta exactly as in :func:`_pallas_attention_bwd`."""
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -415,6 +426,8 @@ def _xla_attention_bwd(q, k, v, o, lse, do, causal: bool):
     dof = do.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v.astype(jnp.float32))
     ds = p * (dp - delta[..., None]) * sm_scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
@@ -438,24 +451,29 @@ def attention_with_stats(
     """Attention + row log-sum-exp, ``[B, H, S, D]`` layout.
 
     Both paths return ``o`` in the query dtype and ``lse`` in float32 —
-    the statistics two hops combine must never be bf16.  This
-    stats-returning form is FORWARD-ONLY (its lse output would need its
-    own cotangent handling); :func:`flash_attention` is the
-    differentiable entry.
+    the statistics two hops combine must never be bf16.
+
+    Differentiable IN BOTH OUTPUTS: the VJP handles the lse cotangent by
+    folding it into the softmax-Jacobian delta term (∂lse/∂s = p, so
+    ``ds = p·(dp − delta + dlse)·scale`` — the same two flash backward
+    kernels, with ``delta − dlse`` as their delta input). This is what
+    makes :func:`ring_flash_attention` trainable: the ring's LSE
+    hop-combining differentiates through these stats.
     """
     return _attention_core(q, k, v, causal)
 
 
 def _aws_fwd(q, k, v, causal):
-    raise NotImplementedError(
-        "attention_with_stats / ring_flash_attention are forward-only "
-        "serving paths; use flash_attention (flash VJP) or "
-        "parallel.ring_attention for training."
-    )
+    o, lse = _attention_core(q, k, v, causal)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _aws_bwd(causal, res, g):  # pragma: no cover - fwd already raises
-    raise NotImplementedError
+def _aws_bwd(causal, res, g):
+    do, dlse = g
+    q, k, v, o, lse = res
+    if jax.default_backend() == "tpu" and _kernel_shapes_ok(q, k):
+        return _pallas_attention_bwd(q, k, v, o, lse, do, causal, dlse=dlse)
+    return _xla_attention_bwd(q, k, v, o, lse, do, causal, dlse=dlse)
 
 
 attention_with_stats.defvjp(_aws_fwd, _aws_bwd)
@@ -511,17 +529,26 @@ def ring_flash_attention(
     mesh: Mesh,
     seq_axis: str = "seq",
     causal: bool = False,
+    data_axis: Optional[str] = None,
 ) -> jax.Array:
     """Exact ring attention with per-hop flash kernels + LSE combining.
 
-    q/k/v: global ``[B, S, H, D]`` sharded ``P(None, seq_axis)``. Under a
+    q/k/v: global ``[B, S, H, D]`` sharded ``P(data_axis, seq_axis)``
+    (``data_axis=None`` replicates the batch; name a mesh axis to compose
+    DP × SP — each data group runs its own independent ring). Under a
     causal mask the hop whose K/V block lies entirely in this shard's
     future is skipped outright (zero FLOPs), past blocks run unmasked, and
     only the diagonal hop pays the masked kernel — the block-level
     causal structure a token-level mask can't exploit.
+
+    Trainable: every piece is reverse-differentiable — the per-hop
+    :func:`attention_with_stats` carries a VJP with lse cotangent
+    handling, and gradients flow back through the hop LSE-combine and the
+    ring rotation (gradient parity vs :func:`ring_attention` is tested on
+    the 8-device mesh, ``tests/test_ring_attention.py``).
     """
     n_ring = mesh.shape[seq_axis]
-    spec = P(None, seq_axis, None, None)
+    spec = P(data_axis, seq_axis, None, None)
 
     def local(q, k, v):
         idx = lax.axis_index(seq_axis)

@@ -75,6 +75,7 @@ def ring_attention(
     mesh: Mesh,
     seq_axis: str = "seq",
     causal: bool = False,
+    data_axis: Optional[str] = None,
 ) -> jax.Array:
     """Exact attention with K/V rotating around the ring.
 
@@ -82,9 +83,11 @@ def ring_attention(
     the P devices holds S/P queries and rotates its K/V shard P times, so
     every Q block sees every K/V block with only neighbor ICI traffic
     (the ring-collective pattern XLA uses for all-gather, but with the
-    flash accumulation fused between hops)."""
+    flash accumulation fused between hops). ``data_axis`` additionally
+    shards the batch dim for DP x SP composition (independent rings per
+    data group)."""
     n_ring = mesh.shape[seq_axis]
-    spec = P(None, seq_axis, None, None)
+    spec = P(data_axis, seq_axis, None, None)
 
     def local(q, k, v):
         # q,k,v local shards [B, S/P, H, D]
@@ -125,6 +128,7 @@ def ulysses_attention(
     seq_axis: str = "seq",
     causal: bool = False,
     impl: str = "reference",
+    data_axis: Optional[str] = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
@@ -137,13 +141,18 @@ def ulysses_attention(
     flash memory behavior in both directions (its VJP regenerates
     probability tiles from the saved lse instead of storing the score
     matrix), making this the long-context TRAINING path at scale;
-    ``'reference'`` is the exact O(S²)-memory formulation."""
+    ``'reference'`` is the exact O(S²)-memory formulation.
+
+    ``data_axis`` names the mesh axis the BATCH dim is sharded over, so
+    DP and SP compose (each data-group runs its own independent
+    all-to-alls over ``seq_axis``) — the ('data', 'seq') serving mesh of
+    :class:`psana_ray_tpu.models.vit.ViTHitClassifier`."""
     p_devices = mesh.shape[seq_axis]
     if q.shape[2] % p_devices != 0:
         raise ValueError(f"heads {q.shape[2]} not divisible by {seq_axis}={p_devices}")
     if impl not in ("reference", "flash"):
         raise ValueError(f"impl must be 'reference' or 'flash', got {impl!r}")
-    spec = P(None, seq_axis, None, None)
+    spec = P(data_axis, seq_axis, None, None)
 
     def local(q, k, v):
         # local [B, S/P, H, D] -> [B, S, H/P, D]

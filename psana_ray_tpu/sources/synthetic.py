@@ -88,6 +88,18 @@ class SyntheticSource:
 
     def event(self, idx: int, mode: str = RetrievalMode.CALIB) -> Tuple[np.ndarray, float]:
         """Generate event ``idx`` (globally indexed). Deterministic."""
+        data, energy, _ = self.event_with_truth(idx, mode)
+        return data, energy
+
+    def event_with_truth(
+        self, idx: int, mode: str = RetrievalMode.CALIB
+    ) -> Tuple[np.ndarray, float, np.ndarray]:
+        """Like :meth:`event`, also returning the PLANTED peak ground
+        truth: ``[n_peaks, 4]`` float32 rows ``(panel, cy, cx, amplitude)``
+        — the oracle peak-quality metrics score against
+        (:func:`psana_ray_tpu.models.peaks.peak_metrics`). Identical rng
+        consumption to :meth:`event`, so frames are bit-identical whether
+        or not the truth is requested."""
         rng = np.random.default_rng((self._seed << 20) ^ idx)
         spec = self.spec
         p, h, w = spec.frame_shape
@@ -97,12 +109,14 @@ class SyntheticSource:
         n_peaks = rng.integers(self.peak_count // 2, self.peak_count + 1)
         yy = np.arange(h, dtype=np.float32)[:, None]
         xx = np.arange(w, dtype=np.float32)[None, :]
-        for _ in range(int(n_peaks)):
+        truth = np.zeros((int(n_peaks), 4), dtype=np.float32)
+        for j in range(int(n_peaks)):
             pi = int(rng.integers(0, p))
             cy, cx = rng.uniform(4, h - 4), rng.uniform(4, w - 4)
             amp = rng.uniform(50, 800)
             sig = rng.uniform(0.8, 2.2)
             photons[pi] += amp * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * sig**2))
+            truth[j] = (pi, cy, cx, amp)
         photon_energy = float(rng.uniform(8.0, 12.0))  # keV
 
         if mode == RetrievalMode.CALIB:
@@ -131,7 +145,7 @@ class SyntheticSource:
             # astype would wrap it to a huge positive count
             info = np.iinfo(self.dtype)
             data = np.clip(data, info.min, info.max)
-        return data.astype(self.dtype, copy=False), photon_energy
+        return data.astype(self.dtype, copy=False), photon_energy, truth
 
     def iter_events(self, mode: str = RetrievalMode.CALIB) -> Iterator[Tuple[np.ndarray, float]]:
         """Yield this shard's events (parity: producer.py:88)."""

@@ -48,6 +48,9 @@ def main():
     if scenario == "stream":
         _stream_scenario(jax, jnp, np, mesh, rank, nprocs)
         return
+    if scenario == "fanin":
+        _fanin_scenario(jax, jnp, np, mesh, rank, nprocs)
+        return
 
     b_local = 4
     local = (
@@ -152,6 +155,89 @@ def _stream_scenario(jax, jnp, np, mesh, rank, nprocs):
     assert flat == want, (rank, flat[:4], want[:4])
 
     print(f"MULTIHOST-STREAM OK rank={rank} frames={n_local}", flush=True)
+
+
+def _fanin_scenario(jax, jnp, np, mesh, rank, nprocs):
+    """Multi-host × multi-detector (round-3 VERDICT weak #5): every host
+    runs TWO detector streams with different geometries and uneven lengths
+    (per host AND per detector); MultiDetectorGlobalConsumer drives both
+    to global completion on one deterministic collective schedule."""
+    import threading
+    import time
+
+    from psana_ray_tpu.infeed.multihost import (
+        GlobalStreamConsumer,
+        MultiDetectorGlobalConsumer,
+    )
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+
+    dets = {
+        # name: (frame shape, frames on THIS host)  — all lengths uneven
+        "epix": ((2, 4, 8), 10 if rank == 0 else 6),
+        "jungfrau": ((1, 8, 8), 3 if rank == 0 else 7),
+    }
+    local_bs = 4
+    queues = {name: RingBuffer(maxsize=8) for name in dets}
+
+    def produce(name):
+        shape, n = dets[name]
+        q = queues[name]
+        for i in range(n):
+            frame = np.full(shape, 100.0 * rank + i + 1, np.float32)
+            while not q.put(FrameRecord(rank, i, frame, 9.5)):
+                time.sleep(0.001)
+        assert q.put_wait(EndOfStream(total_events=n), timeout=30.0)
+
+    threads = [threading.Thread(target=produce, args=(n,), daemon=True) for n in dets]
+    for t in threads:
+        t.start()
+
+    legs = {
+        name: GlobalStreamConsumer(
+            queues[name], local_batch_size=local_bs, mesh=mesh,
+            frame_shape=dets[name][0],
+        )
+        for name in dets
+    }
+
+    def make_step():
+        @jax.jit
+        def _row_sums(frames, valid):
+            m = valid.astype(jnp.float32).reshape(-1, *([1] * (frames.ndim - 1)))
+            return jnp.sum(frames * m, axis=tuple(range(1, frames.ndim)))
+
+        return lambda batch: _row_sums(batch.frames, batch.valid)
+
+    seen = {name: [] for name in dets}
+    counts = MultiDetectorGlobalConsumer(legs).run(
+        {name: make_step() for name in dets},
+        on_result=lambda name, out, g: seen[name].append((out, g)),
+    )
+    for t in threads:
+        t.join(timeout=30)
+
+    for name, (shape, n) in dets.items():
+        assert counts[name] == n, (rank, name, counts)
+        # rounds = the LONGEST host's batch count for this detector
+        n_max = max(10 if name == "epix" else 3, 6 if name == "epix" else 7)
+        assert len(seen[name]) == -(-n_max // local_bs), (rank, name, len(seen[name]))
+        # this host's addressable rows carry exactly its own frame sums;
+        # dedupe by (round, row) — the model axis replicates each row
+        # into multiple addressable shards
+        px = float(np.prod(shape))
+        rows = {}
+        for ri, (out, _) in enumerate(seen[name]):
+            for shard in out.addressable_shards:
+                lo = shard.index[0].start or 0
+                for j, v in enumerate(np.asarray(shard.data)):
+                    if v > 0:
+                        rows[(ri, lo + j)] = float(v)
+        got = sorted(rows.values())
+        want = sorted((100.0 * rank + i + 1) * px for i in range(n))
+        assert got == want, (rank, name, got[:4], want[:4])
+
+    print(f"MULTIHOST-FANIN OK rank={rank} counts={counts}", flush=True)
 
 
 if __name__ == "__main__":

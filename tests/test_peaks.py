@@ -91,3 +91,121 @@ class TestCxiRoundtrip:
             assert wtr.n_events == 4
         n_back, *_ = read_cxi_peaks(path)
         assert list(n_back) == [1, 2, 1, 2]
+
+
+class TestPeakMetrics:
+    """peak_metrics + the synthetic source's planted ground truth
+    (VERDICT r3 #5: the s2d quality numbers need an oracle)."""
+
+    def test_event_with_truth_matches_event(self):
+        from psana_ray_tpu.sources import SyntheticSource
+
+        src = SyntheticSource(num_events=2, detector_name="smoke_a", seed=7)
+        d1, e1 = src.event(1)
+        d2, e2, truth = src.event_with_truth(1)
+        np.testing.assert_array_equal(d1, d2)  # identical rng consumption
+        assert e1 == e2
+        assert truth.shape[1] == 4
+        assert len(truth) >= 1
+        p, h, w = src.spec.frame_shape
+        assert (truth[:, 0] < p).all()
+        assert (truth[:, 1] < h).all() and (truth[:, 2] < w).all()
+
+    def test_truth_peaks_are_in_the_frame(self):
+        from psana_ray_tpu.sources import SyntheticSource
+
+        src = SyntheticSource(num_events=1, detector_name="smoke_a", seed=3)
+        data, _, truth = src.event_with_truth(0)
+        # a bright plant must actually be bright at its center
+        bright = truth[truth[:, 3] > 200]
+        for pi, cy, cx, amp in bright:
+            v = data[int(pi), int(round(cy)), int(round(cx))]
+            assert v > 50, (pi, cy, cx, amp, v)
+
+    def test_metrics_exact_match(self):
+        from psana_ray_tpu.models.peaks import peak_metrics
+
+        pred_yx = np.full((1, 4, 2), -1, np.int32)
+        pred_yx[0, :2] = [[10, 20], [30, 40]]
+        truth = [np.asarray([[0, 10.4, 19.8, 100.0], [0, 29.9, 40.2, 100.0]])]
+        m = peak_metrics(pred_yx, np.asarray([2]), truth, tolerance=2.0)
+        assert m["recall"] == 1.0 and m["precision"] == 1.0
+
+    def test_metrics_miss_and_false_positive(self):
+        from psana_ray_tpu.models.peaks import peak_metrics
+
+        pred_yx = np.full((1, 4, 2), -1, np.int32)
+        pred_yx[0, :2] = [[10, 20], [90, 90]]  # second is spurious
+        truth = [np.asarray([[0, 10, 20, 100.0], [0, 50, 50, 100.0]])]  # second missed
+        m = peak_metrics(pred_yx, np.asarray([2]), truth, tolerance=2.0)
+        assert m["recall"] == 0.5 and m["precision"] == 0.5
+
+    def test_metrics_one_to_one_matching(self):
+        from psana_ray_tpu.models.peaks import peak_metrics
+
+        # two truth peaks near ONE prediction: only one may claim it
+        pred_yx = np.full((1, 4, 2), -1, np.int32)
+        pred_yx[0, :1] = [[10, 10]]
+        truth = [np.asarray([[0, 10, 10, 100.0], [0, 11, 10, 100.0]])]
+        m = peak_metrics(pred_yx, np.asarray([1]), truth, tolerance=3.0)
+        assert m["n_matched"] == 1
+        assert m["recall"] == 0.5 and m["precision"] == 1.0
+
+    def test_min_amplitude_drops_subthreshold_truth(self):
+        from psana_ray_tpu.models.peaks import peak_metrics
+
+        pred_yx = np.full((1, 2, 2), -1, np.int32)
+        truth = [np.asarray([[0, 10, 10, 20.0]])]  # weak plant, no prediction
+        m = peak_metrics(pred_yx, np.asarray([0]), truth, min_amplitude=50.0)
+        assert m["n_truth"] == 0 and m["recall"] == 0.0
+
+    def test_split_truth_by_panel(self):
+        from psana_ray_tpu.models.peaks import split_truth_by_panel
+
+        truth = np.asarray([[0, 1, 2, 9.0], [2, 3, 4, 9.0], [0, 5, 6, 9.0]])
+        parts = split_truth_by_panel(truth, 3)
+        assert [len(p) for p in parts] == [2, 0, 1]
+
+    def test_find_peaks_recovers_planted_truth(self):
+        """End-to-end oracle check WITHOUT a model: sigmoid-space logits
+        built directly from the calibrated frame must recover the bright
+        planted peaks — validates the truth/metric plumbing itself."""
+        import jax.numpy as jnp
+
+        from psana_ray_tpu.models.peaks import (
+            find_peaks,
+            peak_metrics,
+            split_truth_by_panel,
+        )
+        from psana_ray_tpu.sources import SyntheticSource
+
+        # sparse plants: on the tiny smoke panels a dense field overlaps
+        # into merged maxima, which tests geometry, not the plumbing
+        src = SyntheticSource(
+            num_events=1, detector_name="smoke_a", seed=11, peak_count=4
+        )
+        data, _, truth = src.event_with_truth(0)
+        p = src.spec.frame_shape[0]
+        # "perfect segmentation": logit rises with intensity, threshold at
+        # 50 ADU. Scaled so sigmoid cannot saturate to exactly 1.0 in f32
+        # — a saturated plateau ties every pixel and the raster tie-break
+        # elects the plateau's corner, not the peak center
+        logits = jnp.asarray((data - 50.0) * 0.01)[..., None]
+        yx, score, n = find_peaks(logits, max_peaks=64, min_distance=2)
+        m = peak_metrics(
+            np.asarray(yx), np.asarray(n), split_truth_by_panel(truth, p),
+            tolerance=3.0, min_amplitude=100.0,
+        )
+        assert m["recall"] >= 0.9, m
+
+    def test_detection_of_ignored_truth_is_not_a_false_positive(self):
+        from psana_ray_tpu.models.peaks import peak_metrics
+
+        # one strong plant (matched) + one correctly-detected WEAK plant:
+        # the weak detection must not count against precision
+        pred_yx = np.full((1, 4, 2), -1, np.int32)
+        pred_yx[0, :2] = [[10, 10], [40, 40]]
+        truth = [np.asarray([[0, 10, 10, 500.0], [0, 40, 40, 60.0]])]
+        m = peak_metrics(pred_yx, np.asarray([2]), truth, min_amplitude=100.0)
+        assert m["n_truth"] == 1 and m["n_matched"] == 1
+        assert m["precision"] == 1.0 and m["recall"] == 1.0

@@ -20,4 +20,4 @@ from psana_ray_tpu.models.unet_tpu import PeakNetUNetTPU  # noqa: F401
 from psana_ray_tpu.models.heads import panels_to_nhwc  # noqa: F401
 from psana_ray_tpu.models.init import host_init  # noqa: F401
 from psana_ray_tpu.models.fold import export_serving_params, fold_batchnorm  # noqa: F401
-from psana_ray_tpu.models.vit import ViTHitClassifier  # noqa: F401
+from psana_ray_tpu.models.vit import ViTHitClassifier, vit_pipelined_apply  # noqa: F401

@@ -20,6 +20,16 @@ attention function, so the SAME model serves:
 - ring layout: :func:`psana_ray_tpu.parallel.flash.ring_flash_attention`
   (K/V rotate over ICI; trainable since round 4).
 
+It is also the host model for the OTHER two first-class shardings:
+
+- **pipeline parallelism** — ``scan_trunk=True`` stacks the trunk's block
+  params along a leading depth axis (``nn.scan``), and
+  :func:`vit_pipelined_apply` runs them as GPipe stages over a ``pipe``
+  mesh axis (:mod:`psana_ray_tpu.parallel.pp`);
+- **expert parallelism** — ``moe_experts=E`` swaps each block's MLP for a
+  capacity-bounded switch-routing MoE whose expert weights shard over an
+  ``expert`` mesh axis (:mod:`psana_ray_tpu.parallel.moe`).
+
 Attention here is NON-causal (a frame's patches have no temporal order);
 LayerNorm (per-token, batch-independent) needs no train→serve folding.
 bf16 compute / f32 params, f32 logits — same conventions as the conv
@@ -33,6 +43,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from flax.core import meta as nn_meta
+from jax import lax
 
 Dtype = Any
 
@@ -58,6 +70,8 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     dtype: Dtype = jnp.bfloat16
     attn_fn: Optional[Callable] = None  # (q, k, v) -> o, [B, S, H, D]
+    moe_experts: int = 0  # 0 = dense MLP; >0 = switch MoE with E experts
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x):
@@ -77,13 +91,106 @@ class TransformerBlock(nn.Module):
         x = x + nn.Dense(e, use_bias=False, dtype=self.dtype,
                          param_dtype=jnp.float32, name="proj")(o)
 
-        # pre-LN MLP
+        # pre-LN MLP (dense, or expert-parallel switch MoE)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        if self.moe_experts:
+            from psana_ray_tpu.parallel.moe import SwitchMoEMlp
+
+            return x + SwitchMoEMlp(
+                embed_dim=e, num_experts=self.moe_experts,
+                mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype, name="moe",
+            )(y)
         y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype,
                      param_dtype=jnp.float32, name="up")(y)
         y = nn.gelu(y)
         return x + nn.Dense(e, dtype=self.dtype, param_dtype=jnp.float32,
                             name="down")(y)
+
+
+class _BlockCarry(nn.Module):
+    """``(carry, None) -> (carry, None)`` adapter so ``nn.scan`` can stack
+    :class:`TransformerBlock` along a depth axis."""
+
+    embed_dim: int = 0
+    num_heads: int = 0
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, x, _):
+        return TransformerBlock(
+            self.embed_dim, self.num_heads, self.mlp_ratio, dtype=self.dtype,
+            attn_fn=self.attn_fn, moe_experts=self.moe_experts,
+            moe_capacity_factor=self.moe_capacity_factor, name="block",
+        )(x), None
+
+
+class _Embed(nn.Module):
+    patch: int
+    embed_dim: int
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, frames):
+        x = patchify_panels(frames.astype(self.dtype), self.patch)
+        x = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="proj")(x)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, x.shape[1], self.embed_dim), jnp.float32,
+        )
+        return x + pos.astype(self.dtype)
+
+
+class _Trunk(nn.Module):
+    depth: int
+    scan: bool
+    embed_dim: int = 0
+    num_heads: int = 0
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, x):
+        kwargs = dict(
+            embed_dim=self.embed_dim, num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio, dtype=self.dtype, attn_fn=self.attn_fn,
+            moe_experts=self.moe_experts,
+            moe_capacity_factor=self.moe_capacity_factor,
+        )
+        if self.scan:
+            scanned = nn.scan(
+                _BlockCarry,
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True},
+                length=self.depth,
+                metadata_params={nn_meta.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(**kwargs, name="blocks")(x, None)
+            return x
+        for i in range(self.depth):
+            x = TransformerBlock(**kwargs, name=f"block{i}")(x)
+        return x
+
+
+class _Head(nn.Module):
+    num_classes: int
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=1)  # token mean-pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="out")(x)
 
 
 class ViTHitClassifier(nn.Module):
@@ -92,7 +199,12 @@ class ViTHitClassifier(nn.Module):
     ``attn_fn`` is the pluggable attention (see module docstring); the
     default single-device flash path needs no mesh. ``embed_dim /
     num_heads`` defaults to head_dim 128 so real-geometry serving hits
-    the Pallas flash kernel's shape constraints (D % 128 == 0)."""
+    the Pallas flash kernel's shape constraints (D % 128 == 0).
+
+    ``scan_trunk=True`` builds the trunk with ``nn.scan`` — same math,
+    but block params carry a leading depth axis, the form pipeline
+    parallelism (:func:`vit_pipelined_apply`) and per-layer sharding
+    consume. ``moe_experts>0`` makes every block's MLP a switch MoE."""
 
     patch: int = 16
     embed_dim: int = 512
@@ -102,24 +214,81 @@ class ViTHitClassifier(nn.Module):
     num_classes: int = 2
     dtype: Dtype = jnp.bfloat16
     attn_fn: Optional[Callable] = None
+    scan_trunk: bool = False
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
+
+    def _block_kwargs(self):
+        return dict(
+            embed_dim=self.embed_dim, num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio, dtype=self.dtype, attn_fn=self.attn_fn,
+            moe_experts=self.moe_experts,
+            moe_capacity_factor=self.moe_capacity_factor,
+        )
 
     @nn.compact
     def __call__(self, frames):
-        x = patchify_panels(frames.astype(self.dtype), self.patch)
-        x = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32,
-                     name="embed")(x)
-        s = x.shape[1]
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (1, s, self.embed_dim),
-            jnp.float32,
-        )
-        x = x + pos.astype(self.dtype)
-        for _ in range(self.depth):
-            x = TransformerBlock(
-                self.embed_dim, self.num_heads, self.mlp_ratio,
-                dtype=self.dtype, attn_fn=self.attn_fn,
-            )(x)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
-        x = jnp.mean(x.astype(jnp.float32), axis=1)  # token mean-pool
-        return nn.Dense(self.num_classes, dtype=jnp.float32,
-                        param_dtype=jnp.float32, name="head")(x)
+        x = _Embed(self.patch, self.embed_dim, self.dtype, name="embed")(frames)
+        x = _Trunk(self.depth, self.scan_trunk, name="trunk",
+                   **self._block_kwargs())(x)
+        return _Head(self.num_classes, self.dtype, name="head")(x)
+
+
+def vit_pipelined_apply(
+    model: ViTHitClassifier,
+    variables,
+    frames: jax.Array,
+    mesh,
+    pipe_axis: str = "pipe",
+    data_axis: Optional[str] = None,
+    microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Serve a ``scan_trunk=True`` ViT with the trunk pipelined over
+    ``mesh[pipe_axis]`` (GPipe microbatch schedule, activations hopping
+    stage→stage over ICI — :func:`psana_ray_tpu.parallel.pp.pipeline_apply`).
+
+    Embed and head are tiny (one dense each) and run replicated outside
+    the pipeline; the trunk — all the FLOPs — is split into
+    ``mesh.shape[pipe_axis]`` stages of ``depth/S`` consecutive blocks.
+    Fully differentiable: ``jax.grad`` through this function yields the
+    reverse pipeline schedule, so it trains, not just serves. ``attn_fn``
+    must be device-local here (the default flash path; an SP attention's
+    own ``shard_map`` cannot nest inside the pipeline's).
+
+    Limitation: blocks run with only ``params`` bound, so a
+    ``moe_experts>0`` model's router aux loss (sown into
+    ``intermediates``) is NOT surfaced through this path — PP×EP
+    *serving* is exact, but training through it gets no load-balancing
+    pressure; train MoE models via ``model.apply`` +
+    ``make_train_step(aux_loss_weight=...)`` and pipeline at serve time."""
+    from psana_ray_tpu.parallel.pp import pipeline_apply, stack_stages
+
+    if not model.scan_trunk:
+        raise ValueError("vit_pipelined_apply needs a scan_trunk=True model "
+                         "(stacked block params)")
+    params = nn_meta.unbox(variables)["params"]
+    kwargs = model._block_kwargs()
+
+    x = _Embed(model.patch, model.embed_dim, model.dtype).apply(
+        {"params": params["embed"]}, frames
+    )
+    stacked = stack_stages(params["trunk"]["blocks"], mesh.shape[pipe_axis])
+    block = _BlockCarry(**kwargs)
+
+    def stage_fn(stage_params, h):
+        # one stage = depth/S consecutive blocks; lax.scan unstacks the
+        # per-layer leading axis of this stage's param slice
+        def body(h, layer_params):
+            h, _ = block.apply({"params": layer_params}, h, None)
+            return h, None
+
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    x = pipeline_apply(
+        stage_fn, stacked, x, mesh, pipe_axis=pipe_axis,
+        microbatches=microbatches, data_axis=data_axis,
+    )
+    return _Head(model.num_classes, model.dtype).apply(
+        {"params": params["head"]}, x
+    )

@@ -24,3 +24,5 @@ from psana_ray_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from psana_ray_tpu.parallel.pp import pipeline_apply, stack_stages  # noqa: F401
+from psana_ray_tpu.parallel.moe import SwitchMoEMlp, total_aux_loss  # noqa: F401

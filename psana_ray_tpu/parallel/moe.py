@@ -1,0 +1,124 @@
+"""Expert parallelism: capacity-bounded switch-routing mixture of experts.
+
+The reference has no model code, hence no expert parallelism (SURVEY.md §2
+"Parallelism strategies: TP/PP/SP/EP — none"); the task spec makes EP a
+first-class sharding for the TPU build. This is the TPU-idiomatic
+formulation — the GShard/Switch dense-dispatch pattern rather than any
+ragged scatter/gather:
+
+- routing produces a fixed-shape dispatch tensor ``[B, T, E, C]`` (expert
+  capacity ``C`` is STATIC, derived from the token count at trace time),
+  so the whole layer is three einsums with no dynamic shapes — XLA tiles
+  them onto the MXU and, with the expert axis of the weights sharded
+  ``P('expert')``, lowers the token⇄expert re-layout to an all-to-all
+  over ICI;
+- tokens that overflow an expert's capacity are *dropped at this layer
+  only*: their combine weight is zero, and the transformer block's
+  residual connection passes them through unchanged (the standard Switch
+  behavior);
+- the router's load-balancing loss (Switch eq. 4: ``E · Σ_e f_e · p_e``)
+  is sown into the ``intermediates`` collection;
+  ``parallel.steps.make_train_step(aux_loss_weight=...)`` folds it into
+  the training objective.
+
+Sharding: expert weights carry the logical axis ``('expert', ...)`` which
+``ShardingRules`` maps to the mesh's ``expert`` axis; activations need no
+manual constraints — XLA propagates the expert sharding through the
+dispatch einsum (scaling-book recipe: annotate the weights, let the
+compiler place the collectives).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class SwitchMoEMlp(nn.Module):
+    """Drop-in replacement for a transformer MLP: ``[B, T, D] -> [B, T, D]``.
+
+    Top-1 (switch) routing over ``num_experts`` independent
+    ``D -> mlp_ratio·D -> D`` GELU FFNs with expert capacity
+    ``C = ceil(T · capacity_factor / E)``. The gate value scales the chosen
+    expert's output, so the router receives gradients through the scale
+    (the Switch trick that makes hard top-1 routing trainable)."""
+
+    embed_dim: int
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 2.0
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        e, f = self.num_experts, self.mlp_ratio * self.embed_dim
+        cap = max(1, math.ceil(t * self.capacity_factor / e))  # static
+
+        # ---- route (f32: softmax over a handful of logits, negligible) ----
+        logits = nn.Dense(
+            e, dtype=jnp.float32, param_dtype=jnp.float32, name="router"
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [B, T, E]
+        gate = jnp.max(probs, axis=-1)  # [B, T]
+        sel = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e, dtype=jnp.float32)
+        # FIFO position of each token in its expert's queue; -1 where unrouted,
+        # so the capacity one-hot below zeroes both overflow AND unrouted slots
+        pos = jnp.cumsum(sel, axis=1) * sel - 1.0  # [B, T, E]
+        dispatch = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        combine = dispatch * gate[..., None, None]  # [B, T, E, C]
+
+        # load-balance loss on the PRE-capacity assignment (Switch eq. 4)
+        f_frac = jnp.mean(sel, axis=(0, 1))  # fraction of tokens per expert
+        p_mean = jnp.mean(probs, axis=(0, 1))  # mean router prob per expert
+        self.sow("intermediates", "aux_loss", e * jnp.sum(f_frac * p_mean))
+
+        # ---- dispatch -> expert FFN -> combine (three MXU einsums) ----
+        def ep_param(name, init, shape, axes):
+            return self.param(
+                name, nn.with_logical_partitioning(init, axes), shape, jnp.float32
+            )
+
+        w_up = ep_param(
+            "w_up",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e, d, f),
+            ("expert", "embed", "mlp"),
+        )
+        b_up = ep_param("b_up", nn.initializers.zeros, (e, f), ("expert", "mlp"))
+        w_dn = ep_param(
+            "w_dn",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e, f, d),
+            ("expert", "mlp", "embed"),
+        )
+        b_dn = ep_param("b_dn", nn.initializers.zeros, (e, d), ("expert", "embed"))
+
+        dt = self.dtype
+        xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(dt), x.astype(dt))
+        h = nn.gelu(
+            jnp.einsum("ebcd,edf->ebcf", xin, w_up.astype(dt))
+            + b_up[:, None, None, :].astype(dt)
+        )
+        # empty capacity slots compute gelu(bias) garbage here; their combine
+        # weight is zero, so nothing of it reaches the output
+        out = (
+            jnp.einsum("ebcf,efd->ebcd", h, w_dn.astype(dt))
+            + b_dn[:, None, None, :].astype(dt)
+        )
+        return jnp.einsum("btec,ebcd->btd", combine.astype(dt), out).astype(x.dtype)
+
+
+def total_aux_loss(intermediates) -> jax.Array:
+    """Sum every sown ``aux_loss`` in an ``intermediates`` collection
+    (sown values are tuples; scanned trunks stack them along depth)."""
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(intermediates):
+        total = total + jnp.sum(leaf)
+    return total

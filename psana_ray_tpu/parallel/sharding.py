@@ -33,6 +33,9 @@ class ShardingRules:
         ("height", None),
         ("width", None),
         ("seq", "seq"),
+        ("expert", "expert"),
+        ("layers", None),
+        ("stage", "pipe"),
     )
 
     def mesh_axis(self, logical: Optional[str]) -> Optional[str]:

@@ -28,10 +28,18 @@ from psana_ray_tpu.parallel.sharding import ShardingRules
 
 def _mesh_shardings_for_variables(abstract_vars, mesh: Mesh, rules: ShardingRules):
     """Logical-axis metadata (nn.with_logical_partitioning) -> NamedShardings.
-    Unannotated leaves replicate."""
+    Unannotated leaves replicate; rules naming a mesh axis the mesh lacks
+    degrade to replication on that axis (ShardingRules.spec), so e.g. an
+    'expert'-annotated MoE still initializes on a plain ('data','model')
+    mesh."""
     logical = nn.get_partition_spec(abstract_vars)
-    rules_tuple = tuple((l, a) for l, a in rules.rules)
-    return nn.logical_to_mesh_sharding(logical, mesh, rules_tuple)
+    return jax.tree.map(
+        lambda spec: rules.sharding(tuple(spec), mesh)
+        if isinstance(spec, P)
+        else NamedSharding(mesh, P()),
+        logical,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def init_sharded(
@@ -81,6 +89,7 @@ def make_train_step(
     loss_fn: Callable[..., jax.Array],
     donate: bool = True,
     remat: bool = False,
+    aux_loss_weight: float = 0.0,
 ):
     """Build ``(state, batch) -> (state, loss)``.
 
@@ -91,7 +100,13 @@ def make_train_step(
     essential at ResNet-50 scale on a 16 GB chip. ``remat=True`` wraps the
     forward in ``jax.checkpoint`` so the backward pass recomputes
     activations instead of storing them — the FLOPs-for-HBM trade that
-    makes long-sequence / deep-model training fit on chip."""
+    makes long-sequence / deep-model training fit on chip.
+
+    ``aux_loss_weight>0`` runs the forward with the ``intermediates``
+    collection mutable and adds ``weight · Σ`` of every sown ``aux_loss``
+    to the objective — the MoE router's load-balancing term
+    (:mod:`psana_ray_tpu.parallel.moe`). Intermediates are consumed here,
+    never carried into the returned state."""
 
     def _step(state: TrainState, x: jax.Array, batch_aux) -> Tuple[TrainState, jax.Array]:
         # Gradients flow to the 'params' collection only. norm='batch'
@@ -107,8 +122,11 @@ def make_train_step(
 
         def fwd(p, x):
             variables = {**other, "params": p}
-            if has_stats:
-                return model.apply(variables, x, mutable=("batch_stats",))
+            mutable = (("batch_stats",) if has_stats else ()) + (
+                ("intermediates",) if aux_loss_weight else ()
+            )
+            if mutable:
+                return model.apply(variables, x, mutable=mutable)
             return model.apply(variables, x), {}
 
         if remat:
@@ -116,7 +134,15 @@ def make_train_step(
 
         def loss_of(p):
             logits, mutated = fwd(p, x)
-            return loss_fn(logits, batch_aux), mutated
+            loss = loss_fn(logits, batch_aux)
+            if aux_loss_weight:
+                from psana_ray_tpu.parallel.moe import total_aux_loss
+
+                mutated = dict(mutated)
+                loss = loss + aux_loss_weight * total_aux_loss(
+                    mutated.pop("intermediates", {})
+                )
+            return loss, mutated
 
         (loss, mutated), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
         updates, opt_state = optimizer.update(

@@ -1,0 +1,179 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a 'pipe' mesh axis.
+
+The correctness bar for parallel/pp.py: the pipelined computation must
+equal the sequential stage composition exactly (same params), in both
+directions — forward outputs AND gradients — because the backward
+schedule is derived by jax.grad through the ppermute ring, not written by
+hand. The reference has no PP at all (SURVEY.md §2); these tests define
+the behavior."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psana_ray_tpu.models import ViTHitClassifier, vit_pipelined_apply
+from psana_ray_tpu.parallel import create_mesh, pipeline_apply, stack_stages
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    return create_mesh(("pipe",), (4,), devices=jax.devices()[:4])
+
+
+@pytest.fixture(scope="module")
+def dp_pp_mesh():
+    return create_mesh(("data", "pipe"), (2, 4))
+
+
+def _mlp_stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stacked_mlp(rng, n_stages, d):
+    return {
+        "w": jnp.asarray(rng.normal(0, 0.5, (n_stages, d, d)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(0, 0.1, (n_stages, d)).astype(np.float32)),
+    }
+
+
+def _sequential(stacked, x, n_stages):
+    for i in range(n_stages):
+        x = _mlp_stage(jax.tree.map(lambda p: p[i], stacked), x)
+    return x
+
+
+class TestPipelineApply:
+    def test_matches_sequential(self, rng, pipe_mesh):
+        stacked = _stacked_mlp(rng, 4, 8)
+        x = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+        want = _sequential(stacked, x, 4)
+        got = jax.jit(
+            lambda p, x: pipeline_apply(_mlp_stage, p, x, pipe_mesh)
+        )(stacked, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def test_more_microbatches_shrink_nothing(self, rng, pipe_mesh):
+        # M > S changes the schedule (smaller bubble), never the result
+        stacked = _stacked_mlp(rng, 4, 8)
+        x = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+        want = _sequential(stacked, x, 4)
+        got = pipeline_apply(_mlp_stage, stacked, x, pipe_mesh, microbatches=8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_sequential(self, rng, pipe_mesh):
+        # jax.grad through the ring = the reverse pipeline schedule;
+        # param AND input cotangents must match the sequential program
+        stacked = _stacked_mlp(rng, 4, 8)
+        x = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+
+        gp_pp, gx_pp = jax.jit(
+            jax.grad(
+                lambda p, x: jnp.sum(pipeline_apply(_mlp_stage, p, x, pipe_mesh) ** 2),
+                argnums=(0, 1),
+            )
+        )(stacked, x)
+        gp_sq, gx_sq = jax.grad(
+            lambda p, x: jnp.sum(_sequential(p, x, 4) ** 2), argnums=(0, 1)
+        )(stacked, x)
+        for a, b in zip(jax.tree.leaves(gp_pp), jax.tree.leaves(gp_sq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gx_pp), np.asarray(gx_sq), rtol=1e-5, atol=1e-6)
+
+    def test_dp_pp_compose(self, rng, dp_pp_mesh):
+        # batch rows sharded over 'data', stages over 'pipe': each data
+        # group runs an independent pipeline, result is the same function
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stacked = _stacked_mlp(rng, 4, 8)
+        x = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+        want = _sequential(stacked, x, 4)
+        xs = jax.device_put(x, NamedSharding(dp_pp_mesh, P("data")))
+        got = jax.jit(
+            lambda p, x: pipeline_apply(_mlp_stage, p, x, dp_pp_mesh, data_axis="data")
+        )(stacked, xs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def test_rejects_indivisible_microbatches(self, rng, pipe_mesh):
+        stacked = _stacked_mlp(rng, 4, 8)
+        x = jnp.zeros((6, 8), jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_apply(_mlp_stage, stacked, x, pipe_mesh, microbatches=4)
+
+    def test_stack_stages_regroups(self):
+        depth = {"k": jnp.arange(8.0).reshape(8, 1)}
+        staged = stack_stages(depth, 4)
+        assert staged["k"].shape == (4, 2, 1)
+        np.testing.assert_array_equal(np.asarray(staged["k"][1, 0]), [2.0])
+        with pytest.raises(ValueError, match="not divisible"):
+            stack_stages(depth, 3)
+
+
+class TestViTPipelined:
+    """The flagship consumer under PP: scan-trunk ViT, trunk as 4 GPipe
+    stages of depth/4 blocks each."""
+
+    def _vit(self, scan):
+        return ViTHitClassifier(
+            patch=8, embed_dim=64, depth=4, num_heads=4, num_classes=2,
+            dtype=jnp.float32, scan_trunk=scan,
+        )
+
+    def test_scan_trunk_equals_loop_trunk(self, rng):
+        # same math, different param layout: stacking the loop trunk's
+        # block params must reproduce the scanned trunk bit-for-bit
+        loop, scan = self._vit(False), self._vit(True)
+        frames = jnp.asarray(rng.normal(size=(2, 2, 16, 32)).astype(np.float32))
+        vl = loop.init(jax.random.key(0), frames)
+        trunk = vl["params"]["trunk"]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *[trunk[f"block{i}"] for i in range(4)]
+        )
+        vs = {"params": {**vl["params"], "trunk": {"blocks": {"block": stacked}}}}
+        np.testing.assert_allclose(
+            np.asarray(loop.apply(vl, frames)),
+            np.asarray(scan.apply(vs, frames)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_pipelined_matches_plain(self, rng, dp_pp_mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model = self._vit(True)
+        frames = jnp.asarray(rng.normal(size=(8, 2, 16, 32)).astype(np.float32))
+        variables = model.init(jax.random.key(0), frames)
+        want = model.apply(variables, frames)
+        xs = jax.device_put(frames, NamedSharding(dp_pp_mesh, P("data")))
+        got = jax.jit(
+            lambda v, x: vit_pipelined_apply(model, v, x, dp_pp_mesh, data_axis="data")
+        )(variables, xs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+    def test_pipelined_trains(self, rng, dp_pp_mesh):
+        # grads of the pipelined ViT == grads of the plain apply
+        model = self._vit(True)
+        frames = jnp.asarray(rng.normal(size=(8, 2, 16, 32)).astype(np.float32))
+        variables = model.init(jax.random.key(0), frames)
+
+        g_pp = jax.jit(
+            jax.grad(
+                lambda v: jnp.sum(
+                    vit_pipelined_apply(model, v, frames, dp_pp_mesh, data_axis="data") ** 2
+                )
+            )
+        )(variables)
+        g_plain = jax.jit(jax.grad(lambda v: jnp.sum(model.apply(v, frames) ** 2)))(
+            variables
+        )
+        for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_plain)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    def test_requires_scan_trunk(self, rng, dp_pp_mesh):
+        model = self._vit(False)
+        frames = jnp.zeros((8, 2, 16, 32), jnp.float32)
+        variables = model.init(jax.random.key(0), frames)
+        with pytest.raises(ValueError, match="scan_trunk"):
+            vit_pipelined_apply(model, variables, frames, dp_pp_mesh)

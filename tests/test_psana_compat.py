@@ -15,6 +15,16 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _evict_fake_bound_adapter():
+    """monkeypatch restores sys.modules['psana'], but the adapter module
+    imported DURING the test stays cached with the fake bound inside it —
+    a later test's `open_source('mfx…')` would then succeed against the
+    fake instead of raising. Evict it so every test re-imports fresh."""
+    yield
+    sys.modules.pop("psana_ray_tpu.sources.psana_compat", None)
+
+
 class _FakeRaw:
     """det.raw facade: calib/image/raw per event + bad-pixel mask."""
 

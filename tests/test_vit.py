@@ -74,7 +74,7 @@ class TestViTForward:
             model.init, jax.random.key(0),
             jax.ShapeDtypeStruct((1, 16, 352, 384), jnp.float32),
         )
-        pos = shapes["params"]["pos_embed"]
+        pos = shapes["params"]["embed"]["pos_embed"]
         assert pos.shape == (1, 8448, 512)
         assert 8448 % 128 == 0
 

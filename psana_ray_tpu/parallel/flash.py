@@ -53,8 +53,37 @@ from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
-_BLOCK_Q = 128
-_BLOCK_K = 128
+# Minimum tile edge (Mosaic lane constraint) — also the divisibility floor
+# the kernel requires of Sq/Sk. ACTUAL block sizes are picked per call by
+# :func:`_pick_blocks`: 128x128 tiles leave the kernel vector-bound (the
+# f32 softmax/rescale work on a tile rivals its two 128-wide matmuls);
+# growing the K edge amortizes the online-softmax state updates over more
+# MXU work. Measured on v5e-1 at the ViT serving shape [2, 8448, 4, 128]:
+# 128x128 = 16.9 ms, 256x256 = 8.3 ms, 384x1408 = 2.81 ms, plateau ~2.7 ms
+# (~55% MXU util vs the 1.5 ms FLOP floor) — a 6x kernel speedup from
+# block shape alone.
+_BLOCK_MIN = 128
+_MAX_BLOCK_Q = 512
+_MAX_TILE_ELEMS = 1 << 20  # bq*bk cap: the f32 score tile stays ~4 MB VMEM
+_MAX_KV_TILE_ELEMS = 1 << 18  # bk*d cap: K/V tiles (and the dkv backward's
+# two f32 scratches) are double-buffered across grid steps — without this
+# a small-sq / large-d call could pick a bk whose tiles alone blow VMEM
+
+
+def _pick_blocks(sq: int, sk: int, d: int) -> Tuple[int, int]:
+    """Largest (block_q, block_k) multiples of 128 that divide (sq, sk),
+    with block_q capped and both the f32 score tile (bq*bk) and the K/V
+    tile (bk*d) footprints bounded."""
+    bq = max(
+        b for b in range(_BLOCK_MIN, min(sq, _MAX_BLOCK_Q) + 1, _BLOCK_MIN)
+        if sq % b == 0
+    )
+    bk_cap = max(_BLOCK_MIN, min(_MAX_TILE_ELEMS // bq, _MAX_KV_TILE_ELEMS // d))
+    bk = max(
+        b for b in range(_BLOCK_MIN, min(sk, bk_cap) + 1, _BLOCK_MIN)
+        if sk % b == 0
+    )
+    return bq, bk
 
 
 def _xla_attention_with_stats(q, k, v, causal: bool) -> Tuple[jax.Array, jax.Array]:
@@ -184,31 +213,32 @@ def _pallas_attention_with_stats(
     qf = q.reshape(bh, sq, d)
     kf = k.reshape(bh, sk, d)
     vf = v.reshape(bh, sk, d)
-    n_kb = sk // _BLOCK_K
+    block_q, block_k = _pick_blocks(sq, sk, d)
+    n_kb = sk // block_k
 
     kernel = functools.partial(
         _flash_kernel, sm_scale=d**-0.5, causal=causal, n_kb=n_kb
     )
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, sq // _BLOCK_Q, n_kb),
+        grid=(bh, sq // block_q, n_kb),
         in_specs=[
-            pl.BlockSpec((1, _BLOCK_Q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, _BLOCK_K, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, _BLOCK_K, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, _BLOCK_Q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, 1, _BLOCK_Q), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK_Q, 1), jnp.float32),
-            pltpu.VMEM((_BLOCK_Q, 1), jnp.float32),
-            pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -218,7 +248,7 @@ def _pallas_attention_with_stats(
 def _kernel_shapes_ok(q, k) -> bool:
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
-    return d % 128 == 0 and sq % _BLOCK_Q == 0 and sk % _BLOCK_K == 0
+    return d % 128 == 0 and sq % _BLOCK_MIN == 0 and sk % _BLOCK_MIN == 0
 
 
 # ---------------------------------------------------------------------------
@@ -360,11 +390,12 @@ def _pallas_attention_bwd(
     # last-two-dims block constraint
     lsef = lse.reshape(bh, 1, sq)
     deltaf = delta.reshape(bh, 1, sq)
-    n_qb, n_kb = sq // _BLOCK_Q, sk // _BLOCK_K
+    block_q, block_k = _pick_blocks(sq, sk, d)
+    n_qb, n_kb = sq // block_q, sk // block_k
 
-    qspec = pl.BlockSpec((1, _BLOCK_Q, d), lambda i, a, b_: (i, b_, 0))
-    kspec = pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, a, 0))
-    rowspec = pl.BlockSpec((1, 1, _BLOCK_Q), lambda i, a, b_: (i, 0, b_))
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, a, b_: (i, b_, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda i, a, b_: (i, a, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda i, a, b_: (i, 0, b_))
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, n_qb=n_qb
@@ -372,32 +403,32 @@ def _pallas_attention_bwd(
         grid=(bh, n_kb, n_qb),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=[
-            pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, a, 0)),
-            pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, a, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, a, b_: (i, a, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, a, b_: (i, a, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK_K, d), jnp.float32),
-            pltpu.VMEM((_BLOCK_K, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
 
-    qspec2 = pl.BlockSpec((1, _BLOCK_Q, d), lambda i, a, b_: (i, a, 0))
-    kspec2 = pl.BlockSpec((1, _BLOCK_K, d), lambda i, a, b_: (i, b_, 0))
-    rowspec2 = pl.BlockSpec((1, 1, _BLOCK_Q), lambda i, a, b_: (i, 0, a))
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda i, a, b_: (i, a, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda i, a, b_: (i, b_, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q), lambda i, a, b_: (i, 0, a))
     (dq,) = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, n_kb=n_kb
         ),
         grid=(bh, n_qb, n_kb),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
-        out_specs=[pl.BlockSpec((1, _BLOCK_Q, d), lambda i, a, b_: (i, a, 0))],
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda i, a, b_: (i, a, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
-        scratch_shapes=[pltpu.VMEM((_BLOCK_Q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
 

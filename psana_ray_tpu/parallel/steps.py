@@ -61,7 +61,13 @@ def init_sharded(
 
 
 def make_infer_step(model: nn.Module, mesh: Mesh, data_axis: str = "data"):
-    """jit'd ``(variables, x) -> logits`` with batch rows over the data axis."""
+    """jit'd ``(variables, x) -> logits`` with batch rows over the data axis.
+
+    Host arrays are ``device_put`` on the caller's thread each call — one
+    synchronous full-frame H2D copy per batch. That is fine for scripts
+    and tests; a streaming loop should feed pre-placed ``jax.Array``s
+    (which pass through untouched) from the double-buffered prefetcher
+    (``infeed.pipeline.DevicePrefetcher``) so transfers overlap compute."""
     x_sharding = NamedSharding(mesh, P(data_axis))
 
     @jax.jit

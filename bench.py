@@ -811,13 +811,26 @@ def _bench_latency_mode(jax, x_fresh_list, extras, shared, wd):
     x = x_fresh_list[0]
     sweep = {}
     best = None
+    # Self-budgeting sweep: each batch shape costs a compile (cached ~45 s
+    # of trace+parse on this host, cold ~2 min through the tunnel). Gate
+    # each step on the measured cost of the previous one so a warm sweep
+    # runs to B=8 while a cold one stops before tripping the watchdog's
+    # process-killing section deadline.
+    step_cost_s = None  # measured after the first step
     for b in (1, 2, 4, 8):
-        if wd.remaining_s() < 150.0:  # worst cold compile ~2 min + measure
+        # first step: the old fixed 150 s floor (don't over-require when a
+        # warm cache would make it cheap); later steps: 1.3x the measured
+        # previous step + slack
+        needed = 150.0 if step_cost_s is None else 1.3 * step_cost_s + 20.0
+        if wd.remaining_s() < needed:
             sweep["stopped_early"] = f"B={b}+ skipped (watchdog budget)"
-            log(f"latency sweep stopped before B={b}: < 150 s of section budget left")
+            log(f"latency sweep stopped before B={b}: "
+                f"{wd.remaining_s():.0f} s left < {needed:.0f} needed")
             break
+        t_step = time.perf_counter()
         samples = [(x[k * b:(k + 1) * b],) for k in range(min(3, len(x) // b))]
         ms = device_time_ms(jax, infer, (x[:b],), samples, f"latency B{b}", extras)
+        step_cost_s = time.perf_counter() - t_step
         sweep[str(b)] = round(ms, 3)
         if ms < 5.0:
             best = {"batch": b, "ms_per_dispatch": round(ms, 3),

@@ -70,15 +70,22 @@ _MAX_KV_TILE_ELEMS = 1 << 18  # bk*d cap: K/V tiles (and the dkv backward's
 # a small-sq / large-d call could pick a bk whose tiles alone blow VMEM
 
 
-def _pick_blocks(sq: int, sk: int, d: int) -> Tuple[int, int]:
+def _pick_blocks(sq: int, sk: int, d: int, backward: bool = False) -> Tuple[int, int]:
     """Largest (block_q, block_k) multiples of 128 that divide (sq, sk),
     with block_q capped and both the f32 score tile (bq*bk) and the K/V
-    tile (bk*d) footprints bounded."""
+    tile (bk*d) footprints bounded.
+
+    ``backward=True`` halves both caps: the backward kernels keep THREE
+    score-shaped f32 temps live at once (p, dp, ds) plus f32 dk/dv
+    accumulator scratches, so forward-sized blocks can exceed VMEM on
+    shapes (e.g. sq=sk=2048, d=128) that the forward compiles fine."""
+    tile_cap = _MAX_TILE_ELEMS // (2 if backward else 1)
+    kv_cap = _MAX_KV_TILE_ELEMS // (2 if backward else 1)
     bq = max(
         b for b in range(_BLOCK_MIN, min(sq, _MAX_BLOCK_Q) + 1, _BLOCK_MIN)
         if sq % b == 0
     )
-    bk_cap = max(_BLOCK_MIN, min(_MAX_TILE_ELEMS // bq, _MAX_KV_TILE_ELEMS // d))
+    bk_cap = max(_BLOCK_MIN, min(tile_cap // bq, kv_cap // d))
     bk = max(
         b for b in range(_BLOCK_MIN, min(sk, bk_cap) + 1, _BLOCK_MIN)
         if sk % b == 0
@@ -390,7 +397,7 @@ def _pallas_attention_bwd(
     # last-two-dims block constraint
     lsef = lse.reshape(bh, 1, sq)
     deltaf = delta.reshape(bh, 1, sq)
-    block_q, block_k = _pick_blocks(sq, sk, d)
+    block_q, block_k = _pick_blocks(sq, sk, d, backward=True)
     n_qb, n_kb = sq // block_q, sk // block_k
 
     qspec = pl.BlockSpec((1, block_q, d), lambda i, a, b_: (i, b_, 0))

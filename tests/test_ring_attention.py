@@ -416,3 +416,31 @@ def test_ulysses_flash_matches_reference_and_grads(seq_mesh, causal):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-5, err_msg=name
         )
+
+
+class TestPickBlocks:
+    """Block-shape selection invariants (the 6x kernel lever — see
+    PERF_NOTES.md round-4 section): picked blocks must divide the
+    sequence lengths and respect both VMEM footprint caps."""
+
+    def test_vit_serving_shape(self):
+        from psana_ray_tpu.parallel.flash import _pick_blocks
+
+        bq, bk = _pick_blocks(8448, 8448, 128)
+        assert (bq, bk) == (384, 1408)  # measured near-plateau point
+
+    @pytest.mark.parametrize("sq,sk,d", [
+        (128, 128, 128), (512, 512, 128), (384, 1152, 128),
+        (8448, 8448, 128), (256, 8192, 512), (128, 8192, 1024),
+    ])
+    def test_invariants(self, sq, sk, d):
+        from psana_ray_tpu.parallel.flash import (
+            _MAX_KV_TILE_ELEMS, _MAX_TILE_ELEMS, _pick_blocks,
+        )
+
+        bq, bk = _pick_blocks(sq, sk, d)
+        assert sq % bq == 0 and sk % bk == 0
+        assert bq % 128 == 0 and bk % 128 == 0
+        assert bq * bk <= max(_MAX_TILE_ELEMS, 128 * 128)
+        # the K/V-tile cap keeps large-d cross-attention compilable
+        assert bk * d <= max(_MAX_KV_TILE_ELEMS, 128 * d)

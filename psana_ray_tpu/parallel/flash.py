@@ -252,10 +252,22 @@ def _pallas_attention_with_stats(
     return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
 
 
+# Largest head dim the kernels accept: beyond this even the minimum
+# 128-wide K/V block exceeds the BACKWARD kv-tile cap (bk*d with the
+# halved budget), so _pick_blocks' >=128 floor would silently void the
+# documented VMEM bound — such shapes go to the XLA fallback instead.
+_MAX_HEAD_DIM = _MAX_KV_TILE_ELEMS // (2 * _BLOCK_MIN)
+
+
 def _kernel_shapes_ok(q, k) -> bool:
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
-    return d % 128 == 0 and sq % _BLOCK_MIN == 0 and sk % _BLOCK_MIN == 0
+    return (
+        d % 128 == 0
+        and d <= _MAX_HEAD_DIM
+        and sq % _BLOCK_MIN == 0
+        and sk % _BLOCK_MIN == 0
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -117,8 +117,17 @@ class SwitchMoEMlp(nn.Module):
 
 def total_aux_loss(intermediates) -> jax.Array:
     """Sum every sown ``aux_loss`` in an ``intermediates`` collection
-    (sown values are tuples; scanned trunks stack them along depth)."""
+    (sown values are tuples; scanned trunks stack them along depth).
+
+    Filters by key path — only leaves under a dict key named ``aux_loss``
+    count, so other sown intermediates (debug stats, activation probes)
+    can never silently leak into the training objective via
+    ``make_train_step(aux_loss_weight=...)``."""
     total = jnp.zeros((), jnp.float32)
-    for leaf in jax.tree.leaves(intermediates):
-        total = total + jnp.sum(leaf)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        if any(
+            isinstance(k, jax.tree_util.DictKey) and k.key == "aux_loss"
+            for k in path
+        ):
+            total = total + jnp.sum(leaf)
     return total

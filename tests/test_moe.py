@@ -80,6 +80,18 @@ class TestRouting:
         assert collapsed > 3.5  # ~E when all tokens hit one expert
         assert collapsed > balanced
 
+    def test_aux_loss_ignores_other_sown_intermediates(self, rng):
+        """Only leaves under an 'aux_loss' key count (ADVICE r4): a debug
+        stat sown into the same collection must not change the total."""
+        x = jnp.asarray(rng.normal(size=(2, 64, 8)).astype(np.float32))
+        moe = _moe(e=4)
+        v = nn_meta.unbox(moe.init(jax.random.key(0), x))
+        _, inter = moe.apply(v, x, mutable=["intermediates"])
+        want = float(total_aux_loss(inter["intermediates"]))
+        polluted = dict(inter["intermediates"])
+        polluted["debug_stat"] = (jnp.full((), 1e6, jnp.float32),)
+        assert float(total_aux_loss(polluted)) == want
+
     def test_capacity_is_static(self):
         # same module, two token counts -> two capacities, no recompile
         # errors (capacity derives from shapes at trace time)

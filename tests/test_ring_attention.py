@@ -434,13 +434,31 @@ class TestPickBlocks:
         (8448, 8448, 128), (256, 8192, 512), (128, 8192, 1024),
     ])
     def test_invariants(self, sq, sk, d):
+        """Every shape the kernels ACCEPT (d <= _MAX_HEAD_DIM) satisfies
+        the VMEM caps STRICTLY, forward and backward — the >=128 block
+        floor can no longer void them because _kernel_shapes_ok routes
+        larger head dims to the XLA fallback (ADVICE r4)."""
         from psana_ray_tpu.parallel.flash import (
             _MAX_KV_TILE_ELEMS, _MAX_TILE_ELEMS, _pick_blocks,
         )
 
-        bq, bk = _pick_blocks(sq, sk, d)
-        assert sq % bq == 0 and sk % bk == 0
-        assert bq % 128 == 0 and bk % 128 == 0
-        assert bq * bk <= max(_MAX_TILE_ELEMS, 128 * 128)
-        # the K/V-tile cap keeps large-d cross-attention compilable
-        assert bk * d <= max(_MAX_KV_TILE_ELEMS, 128 * d)
+        for backward, div in ((False, 1), (True, 2)):
+            bq, bk = _pick_blocks(sq, sk, d, backward=backward)
+            assert sq % bq == 0 and sk % bk == 0
+            assert bq % 128 == 0 and bk % 128 == 0
+            assert bq * bk <= _MAX_TILE_ELEMS // div
+            assert bk * d <= _MAX_KV_TILE_ELEMS // div
+
+    def test_large_head_dim_rejected(self):
+        """d beyond _MAX_HEAD_DIM (where even a 128-wide block would blow
+        the backward kv-tile cap) must not reach the kernel."""
+        import jax.numpy as jnp
+
+        from psana_ray_tpu.parallel.flash import (
+            _MAX_HEAD_DIM, _kernel_shapes_ok,
+        )
+
+        ok = jnp.zeros((1, 1, 128, _MAX_HEAD_DIM), jnp.bfloat16)
+        big = jnp.zeros((1, 1, 128, 2 * _MAX_HEAD_DIM), jnp.bfloat16)
+        assert _kernel_shapes_ok(ok, ok)
+        assert not _kernel_shapes_ok(big, big)

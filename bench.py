@@ -122,17 +122,10 @@ def _compact_line() -> bytes:
     keys as fit under _COMPACT_CAP. Built freshly on every emit (no shared
     mutable state — signal-handler reentrant); self-checked by parsing the
     exact bytes written, so a malformed final line is impossible."""
-    # snapshot first: the watchdog thread emits while the main thread may
-    # be inserting keys, and iterating a mutating dict raises RuntimeError
-    # — which would unwind the watchdog before its os._exit
-    for _ in range(5):
-        try:
-            snap = dict(_FINAL)
-            break
-        except RuntimeError:
-            continue
-    else:
-        snap = {k: _FINAL.get(k, 0) for k in ("metric", "value", "unit", "vs_baseline")}
+    # snapshot first (atomic C-level copy under the GIL): the watchdog
+    # thread emits while the main thread may be inserting keys, and
+    # ITERATING a mutating dict raises — the copy cannot
+    snap = dict(_FINAL)
     compact = {k: snap.get(k) for k in ("metric", "value", "unit", "vs_baseline")}
     compact["full_extras"] = "bench_full.json"
     for k in _COMPACT_KEYS:
@@ -158,14 +151,16 @@ def emit_final():
     # stdout — it is < _COMPACT_CAP < PIPE_BUF, so every stdout write is
     # atomic on pipes even with the watchdog emitting concurrently; the
     # full dict (which outgrew the driver's tail window in round 4 and is
-    # heading past PIPE_BUF) lives in bench_full.json instead.
+    # heading past PIPE_BUF) lives in bench_full.json instead. stdout goes
+    # FIRST: a hung filesystem blocking the side-file open must not stall
+    # the artifact of record (or the watchdog's path to os._exit).
+    os.write(1, _compact_line())
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_full.json"), "w") as f:
-            json.dump(_FINAL, f)
+            json.dump(dict(_FINAL), f)
     except Exception:
         pass  # side file is best-effort; stdout is the artifact of record
-    os.write(1, _compact_line())
 
 
 class Watchdog:

@@ -232,3 +232,117 @@ def test_global_stream_consumer_wedge_degrades_then_raises():
     with pytest.raises(TransportWedged):
         consumer.run(lambda b: calls.append(b))
     assert calls == []  # no step ran on garbage; loop terminated first
+
+
+class _StallingQueue:
+    """Serves ``records`` then goes silent forever — a live-but-silent
+    producer leg: the transport is healthy, data just stops, no EOS."""
+
+    def __init__(self, records):
+        import threading
+
+        self._records = list(records)
+        self._lock = threading.Lock()
+
+    def get_batch(self, n, timeout=None):
+        import time
+
+        with self._lock:
+            out, self._records = self._records[:n], self._records[n:]
+        if not out and timeout:
+            time.sleep(timeout)
+        return out
+
+    def size(self):
+        return len(self._records)
+
+
+def test_global_stream_consumer_stall_timeout_degrades_then_raises():
+    """Liveness guard (VERDICT r4 weak #6): a silent leg with
+    ``stall_timeout_s`` set degrades to padding — terminating the global
+    loop in bounded time — and the StreamStalled error surfaces AFTER the
+    wind-down, with every pre-stall frame already processed."""
+    import numpy as np
+
+    from psana_ray_tpu.infeed import GlobalStreamConsumer
+    from psana_ray_tpu.infeed.batcher import StreamStalled
+    from psana_ray_tpu.parallel import create_mesh
+    from psana_ray_tpu.records import FrameRecord
+
+    mesh = create_mesh(("data",), (8,))
+    shape = (1, 4, 8)
+    recs = [
+        FrameRecord(0, i, np.full(shape, i + 1.0, np.float32), 9.5)
+        for i in range(8)
+    ]
+    consumer = GlobalStreamConsumer(
+        _StallingQueue(recs), local_batch_size=8, mesh=mesh,
+        frame_shape=shape, poll_interval_s=0.01, stall_timeout_s=0.3,
+    )
+    seen = []
+    with pytest.raises(StreamStalled, match="no EOS"):
+        consumer.run(lambda b: None, on_result=lambda out, g: seen.append(g))
+    # the full pre-stall batch was processed before the guard fired
+    assert sum(int(np.asarray(g.valid).sum()) for g in seen) == len(recs)
+
+
+def test_multi_detector_stalled_leg_does_not_block_healthy_legs():
+    """One wedged ingest node must not hang the pod: the stalled
+    detector degrades to padding while the healthy detector streams to
+    completion; the stall re-raises after the loop with full counts."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from psana_ray_tpu.infeed.batcher import StreamStalled
+    from psana_ray_tpu.infeed.multihost import (
+        GlobalStreamConsumer,
+        MultiDetectorGlobalConsumer,
+    )
+    from psana_ray_tpu.parallel import create_mesh
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+
+    mesh = create_mesh(("data",), (8,))
+    shape = (1, 4, 8)
+    n_healthy = 20
+    healthy_q = RingBuffer(maxsize=8)
+    stalled_q = _StallingQueue(
+        [FrameRecord(0, i, np.zeros(shape, np.float32), 9.5) for i in range(3)]
+    )
+
+    def produce():
+        for i in range(n_healthy):
+            while not healthy_q.put(
+                FrameRecord(0, i, np.full(shape, i + 1.0, np.float32), 9.5)
+            ):
+                time.sleep(0.001)
+        assert healthy_q.put_wait(EndOfStream(total_events=n_healthy), timeout=30.0)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    legs = {
+        "healthy": GlobalStreamConsumer(
+            healthy_q, local_batch_size=8, mesh=mesh, frame_shape=shape,
+            poll_interval_s=0.01,
+        ),
+        "stalled": GlobalStreamConsumer(
+            stalled_q, local_batch_size=8, mesh=mesh, frame_shape=shape,
+            poll_interval_s=0.01, stall_timeout_s=0.3,
+        ),
+    }
+    counts = {}
+
+    class _Counts:
+        def __call__(self, name, out, g):
+            counts[name] = counts.get(name, 0) + int(np.asarray(g.valid).sum())
+
+    with pytest.raises(StreamStalled):
+        MultiDetectorGlobalConsumer(legs).run(
+            {"healthy": lambda b: None, "stalled": lambda b: None},
+            on_result=_Counts(),
+        )
+    t.join(timeout=30)
+    assert counts["healthy"] == n_healthy  # streamed to completion
+    assert counts.get("stalled", 0) == 3  # pre-stall frames not lost

@@ -188,13 +188,44 @@ class CxiWriter:
     provenance (``shard_rank``/``event_idx``) and photon energy
     (``/LCLS/photon_energy_eV``) ride along. Resizable, chunked, flushed
     per batch: a crash loses at most the unflushed tail.
+
+    ``mode='w'`` (default) creates/truncates; ``mode='a'`` re-opens an
+    existing file and APPENDS after its last event — the crash-resume
+    path (``psana-ray-tpu-sfx --cursor_path``), where truncating would
+    permanently lose every durably-written event the cursor has already
+    marked done. Appending requires the same ``max_peaks`` the file was
+    created with (the row width is baked into the datasets).
     """
 
-    def __init__(self, path: str, max_peaks: int = 128):
+    def __init__(self, path: str, max_peaks: int = 128, mode: str = "w"):
+        import os
+
         import h5py
 
         self.path = path
         self.max_peaks = max_peaks
+        if mode not in ("w", "a"):
+            raise ValueError(f"mode must be 'w' or 'a', got {mode!r}")
+        if mode == "a" and os.path.exists(path):
+            self._f = h5py.File(path, "r+")
+            g = self._f["entry_1/result_1"]
+            lcls = self._f["LCLS"]
+            self._n = g["nPeaks"]
+            self._x = g["peakXPosRaw"]
+            self._y = g["peakYPosRaw"]
+            self._i = g["peakTotalIntensity"]
+            self._energy = lcls["photon_energy_eV"]
+            self._rank = lcls["shard_rank"]
+            self._event = lcls["event_idx"]
+            existing = int(self._x.shape[1])
+            if existing != max_peaks:
+                self._f.close()
+                raise ValueError(
+                    f"cannot append with max_peaks={max_peaks}: {path} was "
+                    f"created with max_peaks={existing}"
+                )
+            self._count = int(self._n.shape[0])
+            return
         self._f = h5py.File(path, "w")
         g = self._f.create_group("entry_1").create_group("result_1")
         mk = lambda name, shape, dtype: g.create_dataset(  # noqa: E731

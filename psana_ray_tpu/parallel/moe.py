@@ -6,12 +6,19 @@ first-class sharding for the TPU build. This is the TPU-idiomatic
 formulation — the GShard/Switch dense-dispatch pattern rather than any
 ragged scatter/gather:
 
-- routing produces a fixed-shape dispatch tensor ``[B, T, E, C]`` (expert
-  capacity ``C`` is STATIC, derived from the token count at trace time),
-  so the whole layer is three einsums with no dynamic shapes — XLA tiles
-  them onto the MXU and, with the expert axis of the weights sharded
-  ``P('expert')``, lowers the token⇄expert re-layout to an all-to-all
-  over ICI;
+- routing produces a fixed-shape dispatch tensor (expert capacity is
+  STATIC, derived from the token count at trace time), so the whole layer
+  is three einsums with no dynamic shapes — XLA tiles them onto the MXU
+  and, with the expert axis of the weights sharded ``P('expert')``,
+  lowers the token⇄expert re-layout to an all-to-all over ICI;
+- the token axis is CHUNKED into groups (the GShard/MaxText ``group_size``
+  idiom): capacity is allocated per group of ``G`` consecutive tokens and
+  the dispatch tensor is ``[B·T/G, G, E, C_g]`` with
+  ``C_g = ceil(G·cf/E)`` — its footprint scales with ``T·C_g``, not
+  ``T·C``. The monolithic form at the ViT serving shape (T=8448, E=4,
+  cf=2) is a ~1.1 GB f32 tensor PER LAYER; grouped at G≤512 it is ~9 MB.
+  The trade is that overflow drops are decided within each group instead
+  of globally FIFO (the standard grouped-Switch semantics);
 - tokens that overflow an expert's capacity are *dropped at this layer
   only*: their combine weight is zero, and the transformer block's
   residual connection passes them through unchanged (the standard Switch
@@ -40,25 +47,58 @@ from flax import linen as nn
 Dtype = Any
 
 
+def pick_group_size(t: int, max_group_size: int) -> int:
+    """Largest divisor of ``t`` that is <= ``max_group_size`` (falls back
+    to ``t`` when nothing smaller divides it — tiny sequences simply stay
+    monolithic). Static: derived from trace-time shapes."""
+    if max_group_size <= 0 or t <= max_group_size:
+        return t
+    for g in range(max_group_size, 0, -1):
+        if t % g == 0:
+            return g
+    return t
+
+
 class SwitchMoEMlp(nn.Module):
     """Drop-in replacement for a transformer MLP: ``[B, T, D] -> [B, T, D]``.
 
     Top-1 (switch) routing over ``num_experts`` independent
-    ``D -> mlp_ratio·D -> D`` GELU FFNs with expert capacity
-    ``C = ceil(T · capacity_factor / E)``. The gate value scales the chosen
-    expert's output, so the router receives gradients through the scale
-    (the Switch trick that makes hard top-1 routing trainable)."""
+    ``D -> mlp_ratio·D -> D`` GELU FFNs with per-group expert capacity
+    ``C_g = ceil(G · capacity_factor / E)``. The gate value scales the
+    chosen expert's output, so the router receives gradients through the
+    scale (the Switch trick that makes hard top-1 routing trainable).
+
+    ``group_size`` chunks the token axis for dispatch (see module
+    docstring): None auto-picks the largest divisor of T that is
+    <= ``max_group_size``; pass an explicit divisor of T to pin it.
+    Routing probabilities and gates are per-token and unaffected; only
+    which overflow tokens drop changes (per group vs globally)."""
 
     embed_dim: int
     num_experts: int
     mlp_ratio: int = 4
     capacity_factor: float = 2.0
     dtype: Dtype = jnp.bfloat16
+    group_size: Any = None  # None = auto (largest divisor <= max_group_size)
+    max_group_size: int = 512
 
     @nn.compact
     def __call__(self, x):
-        b, t, d = x.shape
+        b_in, t_in, d = x.shape
         e, f = self.num_experts, self.mlp_ratio * self.embed_dim
+        g = (
+            int(self.group_size)
+            if self.group_size is not None
+            else pick_group_size(t_in, self.max_group_size)
+        )
+        if t_in % g:
+            raise ValueError(
+                f"group_size={g} does not divide the {t_in}-token sequence"
+            )
+        # groups fold into the batch axis: every downstream einsum sees
+        # [B*T/G, G, ...] and the dispatch tensor scales with G, not T
+        x = x.reshape(b_in * (t_in // g), g, d)
+        b, t = x.shape[:2]
         cap = max(1, math.ceil(t * self.capacity_factor / e))  # static
 
         # ---- route (f32: softmax over a handful of logits, negligible) ----
@@ -112,7 +152,8 @@ class SwitchMoEMlp(nn.Module):
             jnp.einsum("ebcf,efd->ebcd", h, w_dn.astype(dt))
             + b_dn[:, None, None, :].astype(dt)
         )
-        return jnp.einsum("btec,ebcd->btd", combine.astype(dt), out).astype(x.dtype)
+        y = jnp.einsum("btec,ebcd->btd", combine.astype(dt), out)
+        return y.reshape(b_in, t_in, d).astype(x.dtype)
 
 
 def total_aux_loss(intermediates) -> jax.Array:

@@ -208,22 +208,33 @@ class CxiWriter:
             raise ValueError(f"mode must be 'w' or 'a', got {mode!r}")
         if mode == "a" and os.path.exists(path):
             self._f = h5py.File(path, "r+")
-            g = self._f["entry_1/result_1"]
-            lcls = self._f["LCLS"]
-            self._n = g["nPeaks"]
-            self._x = g["peakXPosRaw"]
-            self._y = g["peakYPosRaw"]
-            self._i = g["peakTotalIntensity"]
-            self._energy = lcls["photon_energy_eV"]
-            self._rank = lcls["shard_rank"]
-            self._event = lcls["event_idx"]
-            existing = int(self._x.shape[1])
-            if existing != max_peaks:
+            try:
+                g = self._f["entry_1/result_1"]
+                lcls = self._f["LCLS"]
+                self._n = g["nPeaks"]
+                self._x = g["peakXPosRaw"]
+                self._y = g["peakYPosRaw"]
+                self._i = g["peakTotalIntensity"]
+                self._energy = lcls["photon_energy_eV"]
+                self._rank = lcls["shard_rank"]
+                self._event = lcls["event_idx"]
+                existing = int(self._x.shape[1])
+                if existing != max_peaks:
+                    raise ValueError(
+                        f"cannot append with max_peaks={max_peaks}: {path} "
+                        f"was created with max_peaks={existing}"
+                    )
+            except BaseException as e:
+                # close the r+ handle on ANY failure (it holds the HDF5
+                # lock); a missing dataset means a foreign HDF5 layout
                 self._f.close()
-                raise ValueError(
-                    f"cannot append with max_peaks={max_peaks}: {path} was "
-                    f"created with max_peaks={existing}"
-                )
+                if isinstance(e, KeyError):
+                    raise ValueError(
+                        f"{path} exists but is not a CxiWriter file "
+                        f"(missing {e}); refusing to append to a foreign "
+                        f"HDF5 layout"
+                    ) from e
+                raise
             self._count = int(self._n.shape[0])
             return
         self._f = h5py.File(path, "w")

@@ -134,9 +134,22 @@ class _Embed(nn.Module):
     patch: int
     embed_dim: int
     dtype: Dtype
+    # "log1p" compresses the photon dynamic range (calibrated frames span
+    # 0..~10^3 photons) before the patch projection — without it the rare
+    # bright-peak patches produce embeddings orders of magnitude larger
+    # than background ones and a short training run never recovers
+    # (measured: 10-step hit-finding probe stuck at majority-class
+    # accuracy with raw intensities). Param-free, so OLD checkpoints
+    # still LOAD — but their weights were trained under raw intensities:
+    # serve them with input_norm='none' (README compat note).
+    input_norm: str = "log1p"
 
     @nn.compact
     def __call__(self, frames):
+        if self.input_norm == "log1p":
+            frames = jnp.log1p(jnp.maximum(frames.astype(jnp.float32), 0.0))
+        elif self.input_norm != "none":
+            raise ValueError(f"input_norm must be 'log1p'|'none', got {self.input_norm!r}")
         x = patchify_panels(frames.astype(self.dtype), self.patch)
         x = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32,
                      name="proj")(x)
@@ -184,11 +197,23 @@ class _Trunk(nn.Module):
 class _Head(nn.Module):
     num_classes: int
     dtype: Dtype
+    # "max" is the hit-detection inductive bias: a hit is the EXISTENCE
+    # of peak tokens somewhere in the frame, and mean-pooling dilutes a
+    # handful of them by 1/8448 (measured: the mean-pool probe cannot
+    # leave majority-class accuracy in a short run). Param-free — old
+    # checkpoints load but expect pool='mean' (README compat note).
+    pool: str = "max"
 
     @nn.compact
     def __call__(self, x):
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
-        x = jnp.mean(x.astype(jnp.float32), axis=1)  # token mean-pool
+        x = x.astype(jnp.float32)
+        if self.pool == "max":
+            x = jnp.max(x, axis=1)
+        elif self.pool == "mean":
+            x = jnp.mean(x, axis=1)
+        else:
+            raise ValueError(f"pool must be 'max'|'mean', got {self.pool!r}")
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="out")(x)
 
@@ -217,6 +242,8 @@ class ViTHitClassifier(nn.Module):
     scan_trunk: bool = False
     moe_experts: int = 0
     moe_capacity_factor: float = 2.0
+    input_norm: str = "log1p"  # photon-range compression (see _Embed)
+    head_pool: str = "max"  # hit-detection pooling (see _Head)
 
     def _block_kwargs(self):
         return dict(
@@ -228,10 +255,12 @@ class ViTHitClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, frames):
-        x = _Embed(self.patch, self.embed_dim, self.dtype, name="embed")(frames)
+        x = _Embed(self.patch, self.embed_dim, self.dtype,
+                   input_norm=self.input_norm, name="embed")(frames)
         x = _Trunk(self.depth, self.scan_trunk, name="trunk",
                    **self._block_kwargs())(x)
-        return _Head(self.num_classes, self.dtype, name="head")(x)
+        return _Head(self.num_classes, self.dtype, pool=self.head_pool,
+                     name="head")(x)
 
 
 @jax.custom_vjp
@@ -312,7 +341,8 @@ def vit_pipelined_apply(
     params = nn_meta.unbox(variables)["params"]
     kwargs = model._block_kwargs()
 
-    x = _Embed(model.patch, model.embed_dim, model.dtype).apply(
+    x = _Embed(model.patch, model.embed_dim, model.dtype,
+               input_norm=model.input_norm).apply(
         {"params": params["embed"]}, frames
     )
     stacked = stack_stages(params["trunk"]["blocks"], mesh.shape[pipe_axis])
@@ -332,7 +362,7 @@ def vit_pipelined_apply(
         stage_fn, stacked, x, mesh, pipe_axis=pipe_axis,
         microbatches=microbatches, data_axis=data_axis,
     )
-    out = _Head(model.num_classes, model.dtype).apply(
+    out = _Head(model.num_classes, model.dtype, pool=model.head_pool).apply(
         {"params": params["head"]}, x
     )
     return _reject_unbalanced_moe_training(out) if guard_moe else out

@@ -56,11 +56,17 @@ class SfxConfig:
     calib_threshold: float = 10.0  # ADU zero-floor inside fused_calibrate
 
 
-# Per-mode default find_peaks thresholds, keyed by s2d. The quality mode
-# (s2d=2) uses the plain 0.5 decision boundary; the throughput mode's
-# entry is set by the bench's precision/recall threshold sweep (the knee
-# on the synthetic oracle — see README "Throughput operating point").
-DEFAULT_THRESHOLDS = {2: 0.5, 4: 0.5}
+# Per-mode default find_peaks thresholds, keyed by s2d — calibrated on
+# the synthetic oracle's precision/recall sweep (bench _bench_unet_quality
+# on v5e-1, 16-step probe; full curves in bench_full.json):
+#   s2d=2: thr 0.5 IS the knee        -> recall 0.905 / precision 1.000
+#   s2d=4: thr 0.8 is the F1 knee     -> recall 0.456 / precision 0.478
+#          (0.5 gives precision 0.132 — the r4 "unusable as measured"
+#          point; >=0.85 collapses to zero recall)
+# Even calibrated, quarter-res cannot reach indexing-grade precision:
+# treat s2d=4 as a TRIAGE / pre-filter mode (is this frame worth the
+# quality pass?), not a CXI-for-indexing producer — see README.
+DEFAULT_THRESHOLDS = {2: 0.5, 4: 0.8}
 
 
 def infer_s2d(params, num_classes: int = 1) -> int:
@@ -277,7 +283,15 @@ def main(argv=None):
         help="sigmoid probability floor for a peak pixel (default: the "
         "mode's entry in sfx.DEFAULT_THRESHOLDS)",
     )
-    ap.add_argument("--max_peaks", type=int, default=128, help="per event")
+    ap.add_argument(
+        "--max_peaks", type=int, default=128,
+        help="per-EVENT cap: the CXI row width (brightest kept)",
+    )
+    ap.add_argument(
+        "--panel_max_peaks", type=int, default=128,
+        help="per-PANEL device-side candidate cap (fixed top-K shape in "
+        "the compiled step) — distinct from the per-event --max_peaks",
+    )
     ap.add_argument("--min_distance", type=int, default=2)
     ap.add_argument("--max_events", type=int, default=None)
     ap.add_argument("--cursor_path", default=None)
@@ -363,7 +377,7 @@ def main(argv=None):
     features = tuple(int(f) for f in a.features.split(","))
     sfx_cfg = SfxConfig(
         batch_size=a.batch, peak_threshold=a.peak_threshold,
-        max_peaks=a.max_peaks, min_distance=a.min_distance,
+        max_peaks=a.panel_max_peaks, min_distance=a.min_distance,
     )
     log.info(
         "sfx pipeline up: s2d=%d (%s mode), threshold=%.3f, calib=%s",
@@ -403,6 +417,11 @@ def main(argv=None):
                 "end of stream: %d events, %d peaks -> %s",
                 n, pipe.n_peaks, a.output,
             )
+    except ValueError as e:
+        # writer/params misconfiguration (foreign HDF5 layout, max_peaks
+        # mismatch, bad checkpoint tree) — explain and exit, no traceback
+        log.error("%s", e)
+        return 1
     finally:
         if hasattr(queue, "disconnect"):
             queue.disconnect()

@@ -44,6 +44,7 @@ class SyntheticSource:
         dtype: str = "float32",
         peak_count: int = 24,
         start_event: int = 0,
+        hit_fraction: Optional[float] = None,
     ):
         if detector_name not in DETECTORS:
             raise ValueError(f"unknown detector {detector_name!r}; have {sorted(DETECTORS)}")
@@ -56,6 +57,15 @@ class SyntheticSource:
         self.dtype = np.dtype(dtype)
         self.peak_count = peak_count
         self.start_event = start_event  # resume cursor (reference has none, SURVEY.md §5)
+        # hit_fraction: when set, each event is independently a "hit"
+        # (Bragg peaks planted, probability hit_fraction) or a "miss"
+        # (background only, zero truth rows) — the labeled hit-finding
+        # corpus the classifier workloads train/score on (label := any
+        # truth rows). None (default) keeps every event a hit AND keeps
+        # frames bit-identical to pre-knob sources (no extra rng draw).
+        if hit_fraction is not None and not (0.0 <= hit_fraction <= 1.0):
+            raise ValueError(f"hit_fraction must be in [0, 1], got {hit_fraction}")
+        self.hit_fraction = hit_fraction
         self._seed = _stable_seed(exp, run, seed)
 
         self._pedestal: Optional[np.ndarray] = None
@@ -106,7 +116,18 @@ class SyntheticSource:
         # photon background (scattering) + readout noise, in photons
         photons = rng.poisson(0.08, size=(p, h, w)).astype(np.float32)
         # Bragg-like peaks: a few bright 2-D Gaussians on random panels
-        n_peaks = rng.integers(self.peak_count // 2, self.peak_count + 1)
+        # (a "miss" event, drawn per-event when hit_fraction is set,
+        # plants none — its truth is the empty [0, 4] array)
+        is_hit = (
+            True
+            if self.hit_fraction is None
+            else bool(rng.random() < self.hit_fraction)
+        )
+        n_peaks = (
+            rng.integers(self.peak_count // 2, self.peak_count + 1)
+            if is_hit
+            else 0
+        )
         yy = np.arange(h, dtype=np.float32)[:, None]
         xx = np.arange(w, dtype=np.float32)[None, :]
         truth = np.zeros((int(n_peaks), 4), dtype=np.float32)

@@ -268,3 +268,20 @@ def test_mode_mismatch_refused(serving_ckpt, tmp_path):
         "--output", str(tmp_path / "x.cxi"),
     ])
     assert rc == 1
+
+
+def test_cxi_append_refuses_foreign_hdf5(tmp_path):
+    """mode='a' on a valid HDF5 file that is not a CxiWriter file must
+    raise a clear ValueError (and release the handle), not a KeyError."""
+    import h5py
+
+    from psana_ray_tpu.models.peaks import CxiWriter
+
+    path = str(tmp_path / "foreign.h5")
+    with h5py.File(path, "w") as f:
+        f.create_dataset("something_else", data=[1, 2, 3])
+    with pytest.raises(ValueError, match="foreign"):
+        CxiWriter(path, mode="a")
+    # handle released: the file can be reopened for writing immediately
+    with h5py.File(path, "r+") as f:
+        assert "something_else" in f

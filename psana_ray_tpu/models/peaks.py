@@ -313,3 +313,116 @@ def read_cxi_peaks(path: str):
             g["peakTotalIntensity"][:],
             f["LCLS/event_idx"][:],
         )
+
+
+def read_cxi_peaksets(path: str) -> list:
+    """Full round trip: every event of a CxiWriter file as an unpadded
+    :class:`PeakSet` list (provenance + photon energy included)."""
+    import h5py
+
+    out = []
+    with h5py.File(path, "r") as f:
+        g = f["entry_1/result_1"]
+        n = g["nPeaks"][:]
+        x, y, inten = g["peakXPosRaw"][:], g["peakYPosRaw"][:], g["peakTotalIntensity"][:]
+        energy = f["LCLS/photon_energy_eV"][:]
+        rank = f["LCLS/shard_rank"][:]
+        event = f["LCLS/event_idx"][:]
+    for i in range(len(n)):
+        k = int(n[i])
+        out.append(
+            PeakSet(
+                event_idx=int(event[i]), shard_rank=int(rank[i]),
+                y=y[i, :k].astype(np.float32), x=x[i, :k].astype(np.float32),
+                intensity=inten[i, :k].astype(np.float32),
+                photon_energy=float(energy[i]) / 1000.0,  # eV -> keV
+            )
+        )
+    return out
+
+
+def _cxi_row_width(path: str) -> int:
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        return int(f["entry_1/result_1/peakXPosRaw"].shape[1])
+
+
+def merge_cxi(inputs: Sequence[str], output: str,
+              max_peaks: Optional[int] = None, keep: str = "last") -> int:
+    """Merge per-run CXI files into one, deduplicating at-least-once
+    replays on the ``(shard_rank, event_idx)`` provenance stamp.
+
+    This is the other half of the resume story: a crash-resume may
+    re-append events the previous run already wrote (documented in
+    :mod:`psana_ray_tpu.sfx`), and separate runs may write separate
+    files. ``keep='last'`` (default) keeps the LATEST occurrence in
+    input-then-row order — a resumed run's re-processed event supersedes
+    the crashed run's; ``'first'`` keeps the earliest. Output events are
+    sorted by ``(shard_rank, event_idx)`` so the merged file is
+    deterministic regardless of arrival order. Returns the event count.
+
+    ``max_peaks`` defaults to the WIDEST input's row width (a merge must
+    be lossless); an explicit value narrower than some input is refused
+    rather than silently truncating peak lists. ``output`` must not
+    already exist — the merge tool follows the same no-clobber
+    convention as the sfx CLI (which also rules out output==input)."""
+    import os
+
+    if keep not in ("last", "first"):
+        raise ValueError(f"keep must be 'last' or 'first', got {keep!r}")
+    if os.path.exists(output):
+        raise ValueError(
+            f"refusing to overwrite existing {output}; point --output at "
+            f"a new file"
+        )
+    widths = {p: _cxi_row_width(p) for p in inputs}
+    if max_peaks is None:
+        max_peaks = max(widths.values())
+    else:
+        too_wide = {p: w for p, w in widths.items() if w > max_peaks}
+        if too_wide:
+            raise ValueError(
+                f"max_peaks={max_peaks} would truncate peak lists from "
+                f"{sorted(too_wide)} (row width {max(too_wide.values())}); "
+                f"a merge must be lossless — raise max_peaks or omit it"
+            )
+    merged: dict = {}
+    for path in inputs:
+        for ps in read_cxi_peaksets(path):
+            key = (ps.shard_rank, ps.event_idx)
+            if keep == "last" or key not in merged:
+                merged[key] = ps
+    ordered = [merged[k] for k in sorted(merged)]
+    with CxiWriter(output, max_peaks=max_peaks) as w:
+        w.append(ordered)
+    return len(ordered)
+
+
+def merge_cxi_main(argv=None):
+    """``psana-ray-tpu-cxi-merge`` — merge + dedupe per-run CXI files."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="psana-ray-tpu-cxi-merge")
+    ap.add_argument("inputs", nargs="+", help="CXI files, oldest run first")
+    ap.add_argument("--output", required=True, help="must not already exist")
+    ap.add_argument(
+        "--max_peaks", type=int, default=None,
+        help="output row width (default: widest input — lossless); a "
+        "narrower value is refused rather than truncating",
+    )
+    ap.add_argument(
+        "--keep", choices=["last", "first"], default="last",
+        help="which duplicate of a (shard_rank, event_idx) to keep "
+        "(default: last — a resumed run supersedes the crashed one)",
+    )
+    import sys
+
+    a = ap.parse_args(argv)
+    try:
+        n = merge_cxi(a.inputs, a.output, max_peaks=a.max_peaks, keep=a.keep)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"merged {len(a.inputs)} file(s) -> {a.output}: {n} unique events")
+    return 0

@@ -257,6 +257,63 @@ def test_fresh_run_refuses_existing_output(serving_ckpt, tmp_path):
     assert out.read_bytes() == b"not empty"  # untouched
 
 
+def test_merge_cxi_dedupes_at_least_once_replays(tmp_path):
+    """The resume companion: merging a crashed run's file with its
+    resumed run's file drops (shard_rank, event_idx) duplicates, keeping
+    the resumed run's version, sorted deterministically."""
+    from psana_ray_tpu.models.peaks import (
+        CxiWriter,
+        PeakSet,
+        merge_cxi,
+        read_cxi_peaksets,
+    )
+
+    mk = lambda i, v: PeakSet(  # noqa: E731
+        event_idx=i, shard_rank=0,
+        y=np.array([v], np.float32), x=np.array([v], np.float32),
+        intensity=np.array([0.5], np.float32), photon_energy=9.0,
+    )
+    run1, run2 = str(tmp_path / "r1.cxi"), str(tmp_path / "r2.cxi")
+    with CxiWriter(run1, max_peaks=8) as w:
+        w.append([mk(0, 10.0), mk(1, 11.0), mk(2, 12.0)])
+    with CxiWriter(run2, max_peaks=8) as w:  # resume re-processed 2, added 3-4
+        w.append([mk(2, 99.0), mk(3, 13.0), mk(4, 14.0)])
+
+    out = str(tmp_path / "merged.cxi")
+    n = merge_cxi([run1, run2], out)  # max_peaks derived from inputs
+    assert n == 5
+    sets = read_cxi_peaksets(out)
+    assert [p.event_idx for p in sets] == [0, 1, 2, 3, 4]
+    assert sets[2].y[0] == 99.0  # resumed run superseded the crashed one
+    assert sets[0].photon_energy == pytest.approx(9.0)  # keV round trip
+
+    # no-clobber: an existing output is refused, never truncated
+    with pytest.raises(ValueError, match="refusing to overwrite"):
+        merge_cxi([run1, run2], out)
+    # lossless: a narrower explicit max_peaks is refused, not truncated
+    with pytest.raises(ValueError, match="lossless"):
+        merge_cxi([run1, run2], str(tmp_path / "narrow.cxi"), max_peaks=4)
+
+    out2 = str(tmp_path / "merged_first.cxi")
+    merge_cxi([run1, run2], out2, keep="first")
+    assert read_cxi_peaksets(out2)[2].y[0] == 12.0  # first kept instead
+
+
+def test_merge_cxi_cli(tmp_path):
+    from psana_ray_tpu.models.peaks import CxiWriter, PeakSet, merge_cxi_main, read_cxi_peaks
+
+    p = str(tmp_path / "a.cxi")
+    with CxiWriter(p, max_peaks=4) as w:
+        w.append([PeakSet(event_idx=7, shard_rank=1,
+                          y=np.array([1.0], np.float32),
+                          x=np.array([2.0], np.float32),
+                          intensity=np.array([0.9], np.float32))])
+    out = str(tmp_path / "m.cxi")
+    assert merge_cxi_main([p, p, "--output", out]) == 0
+    n, *_, ev = read_cxi_peaks(out)
+    assert len(n) == 1 and int(ev[0]) == 7  # self-merge dedupes
+
+
 def test_mode_mismatch_refused(serving_ckpt, tmp_path):
     """--mode throughput against an s2d=2 checkpoint must refuse (the
     operating mode is a property of the trained tree)."""

@@ -1,4 +1,4 @@
-"""Bragg-peak extraction from segmentation logits + CXI output writer.
+"""Bragg-peak extraction from segmentation logits (device side).
 
 Closes the loop the reference's own packaging names as its mission —
 "Save PeakNet inference results to CXI" (reference ``setup.py:11``; SFX
@@ -19,8 +19,7 @@ validity count) so a streaming consumer never sees a shape change.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -142,287 +141,15 @@ def split_truth_by_panel(truth: np.ndarray, n_panels: int) -> list:
     return [truth[truth[:, 0] == p] for p in range(n_panels)]
 
 
-@dataclasses.dataclass
-class PeakSet:
-    """Host-side peak list for one event (unpadded)."""
-
-    event_idx: int
-    shard_rank: int
-    y: np.ndarray  # [n] float32 row position
-    x: np.ndarray  # [n] float32 col position
-    intensity: np.ndarray  # [n] float32
-    photon_energy: float = 0.0
-
-    @property
-    def n(self) -> int:
-        return len(self.y)
-
-
-def unpad_peaks(yx, score, n, event_idx=None, shard_rank=None, photon_energy=None):
-    """Device outputs of :func:`find_peaks` -> list of host PeakSets."""
-    yx = np.asarray(yx)
-    score = np.asarray(score)
-    n = np.asarray(n)
-    out = []
-    for i in range(len(n)):
-        k = int(n[i])
-        out.append(
-            PeakSet(
-                event_idx=int(event_idx[i]) if event_idx is not None else i,
-                shard_rank=int(shard_rank[i]) if shard_rank is not None else 0,
-                y=yx[i, :k, 0].astype(np.float32),
-                x=yx[i, :k, 1].astype(np.float32),
-                intensity=score[i, :k].astype(np.float32),
-                photon_energy=float(photon_energy[i]) if photon_energy is not None else 0.0,
-            )
-        )
-    return out
-
-
-class CxiWriter:
-    """Append peak lists to a CXI (HDF5) file in the peakfinder layout.
-
-    Datasets (under ``/entry_1/result_1``): ``nPeaks [N]``,
-    ``peakXPosRaw / peakYPosRaw / peakTotalIntensity [N, max_peaks]`` —
-    the layout CrystFEL's CXI interface and psocake write/read. Event
-    provenance (``shard_rank``/``event_idx``) and photon energy
-    (``/LCLS/photon_energy_eV``) ride along. Resizable, chunked, flushed
-    per batch: a crash loses at most the unflushed tail.
-
-    ``mode='w'`` (default) creates/truncates; ``mode='a'`` re-opens an
-    existing file and APPENDS after its last event — the crash-resume
-    path (``psana-ray-tpu-sfx --cursor_path``), where truncating would
-    permanently lose every durably-written event the cursor has already
-    marked done. Appending requires the same ``max_peaks`` the file was
-    created with (the row width is baked into the datasets).
-    """
-
-    def __init__(self, path: str, max_peaks: int = 128, mode: str = "w"):
-        import os
-
-        import h5py
-
-        self.path = path
-        self.max_peaks = max_peaks
-        if mode not in ("w", "a"):
-            raise ValueError(f"mode must be 'w' or 'a', got {mode!r}")
-        if mode == "a" and os.path.exists(path):
-            self._f = h5py.File(path, "r+")
-            try:
-                g = self._f["entry_1/result_1"]
-                lcls = self._f["LCLS"]
-                self._n = g["nPeaks"]
-                self._x = g["peakXPosRaw"]
-                self._y = g["peakYPosRaw"]
-                self._i = g["peakTotalIntensity"]
-                self._energy = lcls["photon_energy_eV"]
-                self._rank = lcls["shard_rank"]
-                self._event = lcls["event_idx"]
-                existing = int(self._x.shape[1])
-                if existing != max_peaks:
-                    raise ValueError(
-                        f"cannot append with max_peaks={max_peaks}: {path} "
-                        f"was created with max_peaks={existing}"
-                    )
-            except BaseException as e:
-                # close the r+ handle on ANY failure (it holds the HDF5
-                # lock); a missing dataset means a foreign HDF5 layout
-                self._f.close()
-                if isinstance(e, KeyError):
-                    raise ValueError(
-                        f"{path} exists but is not a CxiWriter file "
-                        f"(missing {e}); refusing to append to a foreign "
-                        f"HDF5 layout"
-                    ) from e
-                raise
-            self._count = int(self._n.shape[0])
-            return
-        self._f = h5py.File(path, "w")
-        g = self._f.create_group("entry_1").create_group("result_1")
-        mk = lambda name, shape, dtype: g.create_dataset(  # noqa: E731
-            name, shape=(0, *shape), maxshape=(None, *shape), dtype=dtype,
-            chunks=(256, *shape),
-        )
-        self._n = mk("nPeaks", (), np.int32)
-        self._x = mk("peakXPosRaw", (max_peaks,), np.float32)
-        self._y = mk("peakYPosRaw", (max_peaks,), np.float32)
-        self._i = mk("peakTotalIntensity", (max_peaks,), np.float32)
-        lcls = self._f.create_group("LCLS")
-        self._energy = lcls.create_dataset(
-            "photon_energy_eV", shape=(0,), maxshape=(None,), dtype=np.float64,
-            chunks=(256,),
-        )
-        self._rank = lcls.create_dataset(
-            "shard_rank", shape=(0,), maxshape=(None,), dtype=np.int32, chunks=(256,)
-        )
-        self._event = lcls.create_dataset(
-            "event_idx", shape=(0,), maxshape=(None,), dtype=np.int64, chunks=(256,)
-        )
-        self._count = 0
-
-    def append(self, peaks: Sequence[PeakSet]):
-        if not peaks:
-            return
-        m = self.max_peaks
-        start, end = self._count, self._count + len(peaks)
-        for d in (self._n, self._x, self._y, self._i, self._energy, self._rank, self._event):
-            d.resize(end, axis=0)
-        for j, p in enumerate(peaks):
-            k = min(p.n, m)
-            row_x = np.zeros(m, np.float32)
-            row_y = np.zeros(m, np.float32)
-            row_i = np.zeros(m, np.float32)
-            row_x[:k] = p.x[:k]
-            row_y[:k] = p.y[:k]
-            row_i[:k] = p.intensity[:k]
-            i = start + j
-            self._n[i] = k
-            self._x[i] = row_x
-            self._y[i] = row_y
-            self._i[i] = row_i
-            self._energy[i] = p.photon_energy * 1000.0  # keV -> eV
-            self._rank[i] = p.shard_rank
-            self._event[i] = p.event_idx
-        self._count = end
-        self._f.flush()
-
-    @property
-    def n_events(self) -> int:
-        return self._count
-
-    def close(self):
-        self._f.close()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-
-def read_cxi_peaks(path: str):
-    """Read back (nPeaks, x, y, intensity, event_idx) from a CXI file."""
-    import h5py
-
-    with h5py.File(path, "r") as f:
-        g = f["entry_1/result_1"]
-        return (
-            g["nPeaks"][:],
-            g["peakXPosRaw"][:],
-            g["peakYPosRaw"][:],
-            g["peakTotalIntensity"][:],
-            f["LCLS/event_idx"][:],
-        )
-
-
-def read_cxi_peaksets(path: str) -> list:
-    """Full round trip: every event of a CxiWriter file as an unpadded
-    :class:`PeakSet` list (provenance + photon energy included)."""
-    import h5py
-
-    out = []
-    with h5py.File(path, "r") as f:
-        g = f["entry_1/result_1"]
-        n = g["nPeaks"][:]
-        x, y, inten = g["peakXPosRaw"][:], g["peakYPosRaw"][:], g["peakTotalIntensity"][:]
-        energy = f["LCLS/photon_energy_eV"][:]
-        rank = f["LCLS/shard_rank"][:]
-        event = f["LCLS/event_idx"][:]
-    for i in range(len(n)):
-        k = int(n[i])
-        out.append(
-            PeakSet(
-                event_idx=int(event[i]), shard_rank=int(rank[i]),
-                y=y[i, :k].astype(np.float32), x=x[i, :k].astype(np.float32),
-                intensity=inten[i, :k].astype(np.float32),
-                photon_energy=float(energy[i]) / 1000.0,  # eV -> keV
-            )
-        )
-    return out
-
-
-def _cxi_row_width(path: str) -> int:
-    import h5py
-
-    with h5py.File(path, "r") as f:
-        return int(f["entry_1/result_1/peakXPosRaw"].shape[1])
-
-
-def merge_cxi(inputs: Sequence[str], output: str,
-              max_peaks: Optional[int] = None, keep: str = "last") -> int:
-    """Merge per-run CXI files into one, deduplicating at-least-once
-    replays on the ``(shard_rank, event_idx)`` provenance stamp.
-
-    This is the other half of the resume story: a crash-resume may
-    re-append events the previous run already wrote (documented in
-    :mod:`psana_ray_tpu.sfx`), and separate runs may write separate
-    files. ``keep='last'`` (default) keeps the LATEST occurrence in
-    input-then-row order — a resumed run's re-processed event supersedes
-    the crashed run's; ``'first'`` keeps the earliest. Output events are
-    sorted by ``(shard_rank, event_idx)`` so the merged file is
-    deterministic regardless of arrival order. Returns the event count.
-
-    ``max_peaks`` defaults to the WIDEST input's row width (a merge must
-    be lossless); an explicit value narrower than some input is refused
-    rather than silently truncating peak lists. ``output`` must not
-    already exist — the merge tool follows the same no-clobber
-    convention as the sfx CLI (which also rules out output==input)."""
-    import os
-
-    if keep not in ("last", "first"):
-        raise ValueError(f"keep must be 'last' or 'first', got {keep!r}")
-    if os.path.exists(output):
-        raise ValueError(
-            f"refusing to overwrite existing {output}; point --output at "
-            f"a new file"
-        )
-    widths = {p: _cxi_row_width(p) for p in inputs}
-    if max_peaks is None:
-        max_peaks = max(widths.values())
-    else:
-        too_wide = {p: w for p, w in widths.items() if w > max_peaks}
-        if too_wide:
-            raise ValueError(
-                f"max_peaks={max_peaks} would truncate peak lists from "
-                f"{sorted(too_wide)} (row width {max(too_wide.values())}); "
-                f"a merge must be lossless — raise max_peaks or omit it"
-            )
-    merged: dict = {}
-    for path in inputs:
-        for ps in read_cxi_peaksets(path):
-            key = (ps.shard_rank, ps.event_idx)
-            if keep == "last" or key not in merged:
-                merged[key] = ps
-    ordered = [merged[k] for k in sorted(merged)]
-    with CxiWriter(output, max_peaks=max_peaks) as w:
-        w.append(ordered)
-    return len(ordered)
-
-
-def merge_cxi_main(argv=None):
-    """``psana-ray-tpu-cxi-merge`` — merge + dedupe per-run CXI files."""
-    import argparse
-
-    ap = argparse.ArgumentParser(prog="psana-ray-tpu-cxi-merge")
-    ap.add_argument("inputs", nargs="+", help="CXI files, oldest run first")
-    ap.add_argument("--output", required=True, help="must not already exist")
-    ap.add_argument(
-        "--max_peaks", type=int, default=None,
-        help="output row width (default: widest input — lossless); a "
-        "narrower value is refused rather than truncating",
-    )
-    ap.add_argument(
-        "--keep", choices=["last", "first"], default="last",
-        help="which duplicate of a (shard_rank, event_idx) to keep "
-        "(default: last — a resumed run supersedes the crashed one)",
-    )
-    import sys
-
-    a = ap.parse_args(argv)
-    try:
-        n = merge_cxi(a.inputs, a.output, max_peaks=a.max_peaks, keep=a.keep)
-    except ValueError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    print(f"merged {len(a.inputs)} file(s) -> {a.output}: {n} unique events")
-    return 0
+# Host-side CXI layer (writer, readers, merge tool): moved to the
+# jax-free :mod:`psana_ray_tpu.cxi` so the merge CLI and analysis-host
+# readers need no jax/flax import; re-exported here for compatibility.
+from psana_ray_tpu.cxi import (  # noqa: E402,F401
+    CxiWriter,
+    PeakSet,
+    merge_cxi,
+    merge_cxi_main,
+    read_cxi_peaks,
+    read_cxi_peaksets,
+    unpad_peaks,
+)

@@ -15,7 +15,10 @@ jitted device program per batch shape (fixed shapes from the batcher; the
 peak list is top-K padded, so streaming never recompiles); only the
 final ``(yx, score, n)`` tuples come back to the host, where panel-local
 coordinates fold into the CrystFEL-style unassembled layout and append to
-the CXI file.
+the CXI file. The serving loop keeps ONE batch in flight: batch N runs
+on device while batch N-1's host fold + HDF5 append proceed (JAX's async
+dispatch — blocking only happens at the ``np.asarray`` drain), so host
+write time hides under device compute instead of serializing with it.
 
 Coordinate convention (``peakYPosRaw``/``peakXPosRaw``): the cheetah-style
 vertically stacked panel layout — ``y_raw = panel * H + y_panel``,
@@ -166,15 +169,27 @@ class SfxPipeline:
         )
 
     # -- host side: panel rows -> per-event raw-coordinate peak sets ------
-    def process_batch(self, batch, cursor=None) -> int:
-        """Run one :class:`~psana_ray_tpu.infeed.batcher.Batch` through the
-        device step and append its REAL events to the CXI file; returns
-        the number of events appended. Padding rows never reach the file;
-        the cursor (if given) advances only after an event is written."""
-        from psana_ray_tpu.models.peaks import PeakSet
+    def dispatch(self, batch):
+        """Enqueue one batch's device step WITHOUT waiting for the result.
 
+        The jit call returns as soon as the transfer + computation are
+        enqueued; pairing it with :meth:`drain` one batch later overlaps
+        the device program for batch N with the host-side peak fold and
+        HDF5 append for batch N-1 (the serial loop leaves the chip idle
+        for the whole host phase). :meth:`run` uses exactly this one-deep
+        schedule; results are bit-identical to the serial path."""
+        return self._step(batch.frames), batch
+
+    def drain(self, pending, cursor=None) -> int:
+        """Block on a :meth:`dispatch` handle and append its REAL events
+        to the CXI file; returns the number of events appended. Padding
+        rows never reach the file; the cursor (if given) advances only
+        after an event is written."""
+        from psana_ray_tpu.cxi import PeakSet
+
+        out, batch = pending
         b, p, h, _ = batch.frames.shape
-        yx, score, n = (np.asarray(a) for a in self._step(batch.frames))
+        yx, score, n = (np.asarray(a) for a in out)
         sets = []
         for i in range(b):
             if not batch.valid[i]:
@@ -206,6 +221,11 @@ class SfxPipeline:
         self.n_events += len(sets)
         return len(sets)
 
+    def process_batch(self, batch, cursor=None) -> int:
+        """Serial convenience: :meth:`dispatch` + :meth:`drain` in one
+        call (no overlap; :meth:`run` pipelines them instead)."""
+        return self.drain(self.dispatch(batch), cursor=cursor)
+
     def run(
         self,
         queue,
@@ -217,23 +237,55 @@ class SfxPipeline:
         max_events: Optional[int] = None,
     ) -> int:
         """Drain ``queue`` to EOS (or ``stop``/``max_events``) through the
-        pipeline; returns events written this run."""
+        pipeline; returns events written this run.
+
+        One-deep device/host pipelining: batch N's device step executes
+        while batch N-1's peaks fold into raw coordinates and append to
+        the HDF5 file on the host (see :meth:`dispatch`) — the serial
+        loop pays host-write time as chip idle time. The in-flight batch
+        is always drained before returning (it was dispatched, and the
+        producer will not re-send it), so ``stop`` and ``max_events`` may
+        overshoot the serial loop's stopping point by one extra batch:
+        up to ``2*batch_size - 1`` events past the bound, vs the serial
+        loop's ``batch_size - 1``."""
         from psana_ray_tpu.infeed.batcher import batches_from_queue
 
         start = self.n_events
-        for batch in batches_from_queue(
-            queue, self.cfg.batch_size, poll_interval_s=poll_interval_s, stop=stop
-        ):
-            self.process_batch(batch, cursor=cursor)
+
+        def _drain_one(pending) -> bool:
+            """Drain + cursor bookkeeping; True = hit the max_events bound."""
+            wrote = self.drain(pending, cursor=cursor)
             if cursor is not None and cursor_path and cursor_save_every > 0:
                 if (self.n_events // cursor_save_every) != (
-                    (self.n_events - batch.num_valid) // cursor_save_every
+                    (self.n_events - wrote) // cursor_save_every
                 ):
                     cursor.save(cursor_path)
-            if max_events is not None and self.n_events - start >= max_events:
-                break
-        if cursor is not None and cursor_path:
-            cursor.save(cursor_path)
+            return max_events is not None and self.n_events - start >= max_events
+
+        pending = None
+        try:
+            for batch in batches_from_queue(
+                queue, self.cfg.batch_size, poll_interval_s=poll_interval_s, stop=stop
+            ):
+                nxt = self.dispatch(batch)
+                # clear ``pending`` BEFORE draining it: if drain raises
+                # after its writer.append, the finally below must not
+                # drain the same handle again (duplicate CXI rows)
+                prev, pending = pending, None
+                if prev is not None and _drain_one(prev):
+                    pending = nxt
+                    break
+                pending = nxt
+        finally:
+            try:
+                if pending is not None:
+                    prev, pending = pending, None
+                    _drain_one(prev)
+            finally:
+                # the durable watermark is saved even when a drain raised
+                # (everything it covers WAS written)
+                if cursor is not None and cursor_path:
+                    cursor.save(cursor_path)
         return self.n_events - start
 
 
@@ -326,7 +378,7 @@ def main(argv=None):
 
     from psana_ray_tpu.checkpoint import StreamCursor, load_params
     from psana_ray_tpu.config import TransportConfig
-    from psana_ray_tpu.models.peaks import CxiWriter
+    from psana_ray_tpu.cxi import CxiWriter
     from psana_ray_tpu.transport.addressing import open_queue
 
     variables = load_params(a.serving_params)
@@ -405,6 +457,9 @@ def main(argv=None):
             pipe = SfxPipeline(
                 variables, writer, features=features, calib=calib, config=sfx_cfg
             )
+            import time
+
+            t0 = time.monotonic()
             n = pipe.run(
                 queue,
                 cursor=cursor,
@@ -413,9 +468,11 @@ def main(argv=None):
                 stop=stop_ev,  # SIGINT -> clean stop between batches
                 max_events=a.max_events,
             )
+            dt = time.monotonic() - t0
             log.info(
-                "end of stream: %d events, %d peaks -> %s",
-                n, pipe.n_peaks, a.output,
+                "end of stream: %d events, %d peaks -> %s (%.1f s wall, "
+                "%.1f events/s incl. first-batch compile)",
+                n, pipe.n_peaks, a.output, dt, n / dt if dt > 0 else 0.0,
             )
     except ValueError as e:
         # writer/params misconfiguration (foreign HDF5 layout, max_peaks

@@ -218,6 +218,48 @@ def test_sfx_cli_subprocess_over_shm(serving_ckpt, tmp_path):
         ring.destroy()
 
 
+def test_max_events_bound_drains_in_flight_batch(serving_ckpt, tmp_path):
+    """--max_events stops the run near the bound; the one-deep pipelined
+    loop may overshoot by at most one extra batch (which MUST still be
+    written — it was dispatched, and the producer will not re-send it),
+    and every written event is covered by the saved cursor."""
+    from psana_ray_tpu.checkpoint import StreamCursor, load_params
+    from psana_ray_tpu.config import PipelineConfig, SourceConfig
+    from psana_ray_tpu.models.peaks import CxiWriter, read_cxi_peaks
+    from psana_ray_tpu.producer import ProducerRuntime
+    from psana_ray_tpu.sfx import SfxConfig, SfxPipeline
+    from psana_ray_tpu.transport.addressing import open_queue
+
+    cfg = PipelineConfig(
+        source=SourceConfig(
+            exp="synthetic", run=EVAL_RUN, num_events=N_EVENTS,
+            detector_name=DET, seed=SEED,
+        )
+    )
+    ProducerRuntime(cfg).run(block=False)
+    queue = open_queue(cfg.transport)
+    cxi = str(tmp_path / "bounded.cxi")
+    cursor_path = str(tmp_path / "bounded.cursor")
+    cursor = StreamCursor(stride=1)
+    with CxiWriter(cxi, max_peaks=32) as writer:
+        pipe = SfxPipeline(
+            load_params(serving_ckpt), writer, features=FEATURES,
+            config=SfxConfig(batch_size=2),
+        )
+        n = pipe.run(
+            queue, cursor=cursor, cursor_path=cursor_path, max_events=5,
+        )
+    # bound reached, overshoot bounded by batch granularity + one in flight
+    assert 5 <= n <= 5 + 2 * 2 - 1
+    n_rows, *_ , event_idx = read_cxi_peaks(cxi)
+    assert len(n_rows) == n
+    # the durable watermark covers exactly what was written (contiguous
+    # prefix: single shard, in-order stream)
+    assert StreamCursor.load(cursor_path).resume_point(0) == n
+    if hasattr(queue, "close"):
+        queue.close()
+
+
 def test_cxi_writer_append_mode(tmp_path):
     """Crash-resume must never truncate durably-written events: mode='a'
     re-opens and appends after the last event; a max_peaks mismatch (row
@@ -297,6 +339,42 @@ def test_merge_cxi_dedupes_at_least_once_replays(tmp_path):
     out2 = str(tmp_path / "merged_first.cxi")
     merge_cxi([run1, run2], out2, keep="first")
     assert read_cxi_peaksets(out2)[2].y[0] == 12.0  # first kept instead
+
+
+def test_merge_cxi_streaming_chunks_and_bad_inputs(tmp_path):
+    """chunk_events smaller than the event count must not change the
+    result (the two-pass streaming path); a missing input path and a
+    foreign HDF5 layout are clean CLI errors, not tracebacks."""
+    import h5py
+
+    from psana_ray_tpu.models.peaks import (
+        CxiWriter, PeakSet, merge_cxi, merge_cxi_main, read_cxi_peaksets,
+    )
+
+    mk = lambda i, v: PeakSet(  # noqa: E731
+        event_idx=i, shard_rank=i % 2,
+        y=np.array([v], np.float32), x=np.array([v], np.float32),
+        intensity=np.array([0.5], np.float32), photon_energy=9.0,
+    )
+    src = str(tmp_path / "src.cxi")
+    with CxiWriter(src, max_peaks=8) as w:
+        w.append([mk(i, float(i)) for i in range(7)])
+    out = str(tmp_path / "chunked.cxi")
+    assert merge_cxi([src], out, chunk_events=2) == 7
+    sets = read_cxi_peaksets(out)
+    # sorted by (shard_rank, event_idx): evens (rank 0) then odds (rank 1)
+    assert [p.event_idx for p in sets] == [0, 2, 4, 6, 1, 3, 5]
+    assert all(p.y[0] == p.event_idx for p in sets)
+
+    rc = merge_cxi_main([str(tmp_path / "nope.cxi"), "--output",
+                         str(tmp_path / "x.cxi")])
+    assert rc == 1  # missing input: clean error, not an h5py traceback
+
+    foreign = str(tmp_path / "foreign.h5")
+    with h5py.File(foreign, "w") as f:
+        f.create_dataset("d", data=[1])
+    rc = merge_cxi_main([foreign, "--output", str(tmp_path / "y.cxi")])
+    assert rc == 1  # foreign layout: refused with the ValueError message
 
 
 def test_merge_cxi_cli(tmp_path):

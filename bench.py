@@ -254,10 +254,14 @@ def run_section(wd: Watchdog, name: str, fn, budget_s: float = SECTION_BUDGET_S)
             fn()
         except Exception as e:
             took = time.monotonic() - t0
+            # the failed attempt's duration LOWER-bounds a successful
+            # retry (the exception aborted it early), so demand budget
+            # for twice that and never less than 90 s — tripping the
+            # watchdog mid-retry forfeits every later section
             if (
                 not _is_transient_tunnel_error(e)
                 or _is_backend_unavailable(e)
-                or wd.remaining_s() < took + 30.0
+                or wd.remaining_s() < max(2.0 * took, 90.0)
             ):
                 raise
             log(f"{name} transient tunnel failure, retrying once: {e!r}")
@@ -622,7 +626,7 @@ def main():
         backend_dead |= run_section(
             wd,
             "e2e-streaming",
-            lambda: _bench_e2e_streaming(jax, calib, pool, batch_size, extras),
+            lambda: _bench_e2e_streaming(jax, calib, pool, batch_size, extras, wd),
         )
 
     # ---------------- config 5: multi-detector fan-in --------------------
@@ -780,7 +784,7 @@ def _bench_sfx(jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras, sha
     variables = shared.get("unet_serving")
     if variables is None:
         variables = _serving_params(PeakNetUNetTPU, (1, 64, 64, 1), extras, "sfx")
-    b = 2
+    b = SfxConfig.batch_size  # judged at the CLI's shipped default
     pipe = SfxPipeline(
         variables, _NullWriter(), calib=(pedestal, gain, mask),
         config=SfxConfig(batch_size=b),
@@ -1145,7 +1149,7 @@ def _bench_tunnel_h2d(jax, fresh_frames, extras):
     extras["env_bound_tunnel_h2d_sample_mb"] = round(nbytes / 1e6, 1)
 
 
-def _bench_e2e_streaming(jax, calib, pool, batch_size, extras):
+def _bench_e2e_streaming(jax, calib, pool, batch_size, extras, wd=None):
     """Configs 1-2: producer -> transport -> batcher -> prefetch -> device
     calib, over the shm ring when the native lib builds here (else the
     in-process ring). Records passthrough fps (no device work) and the
@@ -1191,6 +1195,10 @@ def _bench_e2e_streaming(jax, calib, pool, batch_size, extras):
     # judged key would record the stall, not the framework
     trials = []
     for _ in range(3):
+        # a stalled-host trial can eat ~20 s (measured); keep enough
+        # section budget for config 2's streaming run + compile below
+        if trials and wd is not None and wd.remaining_s() < 150.0:
+            break
         q1 = make_queue()
         t_prod = threading.Thread(target=produce, args=(q1,), daemon=True)
         t0 = time.perf_counter()

@@ -46,7 +46,12 @@ import numpy as np
 class SfxConfig:
     """Knobs of the assembled pipeline (CLI flags parse into this)."""
 
-    batch_size: int = 2  # frames per device dispatch
+    # frames per device dispatch. 8 is the measured throughput knee on
+    # v5e for the s2d=2 step (B=2/4/8 -> 119/111/145 fps/chip: the
+    # 128-panel-row batch tiles the U-Net convs better); per-dispatch
+    # latency is ~55 ms at B=8 — latency-sensitive consumers should pass
+    # a smaller --batch, throughput (CXI production) wants this default
+    batch_size: int = 8
     peak_threshold: float = 0.5  # sigmoid prob floor for find_peaks
     # per-PANEL candidate cap inside find_peaks (fixed device shapes); the
     # per-EVENT cap in the CXI file is writer.max_peaks — an event keeps
@@ -62,10 +67,13 @@ class SfxConfig:
 # Per-mode default find_peaks thresholds, keyed by s2d — calibrated on
 # the synthetic oracle's precision/recall sweep (bench _bench_unet_quality
 # on v5e-1, 16-step probe; full curves in bench_full.json):
-#   s2d=2: thr 0.5 IS the knee        -> recall 0.905 / precision 1.000
-#   s2d=4: thr 0.8 is the F1 knee     -> recall 0.456 / precision 0.478
-#          (0.5 gives precision 0.132 — the r4 "unusable as measured"
-#          point; >=0.85 collapses to zero recall)
+#   s2d=2: thr 0.5 IS the knee        -> recall ~0.9 / precision 1.000
+#          (stable across probe runs)
+#   s2d=4: the F1 knee lands at 0.7-0.8 across probe re-runs (the 16-step
+#          probe is nondeterministic; e.g. 0.8 -> recall 0.456/prec 0.478
+#          one run, 0.7 -> 0.631/0.209 another). 0.8 stays the default:
+#          0.5 gives precision ~0.13 — the r4 "unusable as measured"
+#          point — and >=0.85 collapses to zero recall
 # Even calibrated, quarter-res cannot reach indexing-grade precision:
 # treat s2d=4 as a TRIAGE / pre-filter mode (is this frame worth the
 # quality pass?), not a CXI-for-indexing producer — see README.
@@ -329,7 +337,11 @@ def main(argv=None):
         help="npz with pedestal/gain/mask [P,H,W] arrays — give it when "
         "the stream carries RAW ADUs; omit for producer-calibrated streams",
     )
-    ap.add_argument("--batch", type=int, default=2, help="frames per dispatch")
+    ap.add_argument(
+        "--batch", type=int, default=SfxConfig.batch_size,
+        help="frames per device dispatch (default: the measured "
+        "throughput knee; lower it for latency-sensitive serving)",
+    )
     ap.add_argument(
         "--peak_threshold", type=float, default=None,
         help="sigmoid probability floor for a peak pixel (default: the "

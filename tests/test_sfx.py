@@ -377,6 +377,33 @@ def test_merge_cxi_streaming_chunks_and_bad_inputs(tmp_path):
     assert rc == 1  # foreign layout: refused with the ValueError message
 
 
+def test_merge_cxi_interleaved_files_chunked(tmp_path):
+    """Winners interleave across input files within one output slab (the
+    batched pass-2 read groups rows by file and must reassemble them in
+    sorted key order): odd events in one file, even in the other, with a
+    chunk smaller than either file's contribution."""
+    from psana_ray_tpu.cxi import CxiWriter, PeakSet, merge_cxi, read_cxi_peaksets
+
+    mk = lambda i: PeakSet(  # noqa: E731
+        event_idx=i, shard_rank=0,
+        y=np.array([float(i)], np.float32), x=np.array([0.0], np.float32),
+        intensity=np.array([1.0], np.float32), photon_energy=8.0,
+    )
+    evens, odds = str(tmp_path / "e.cxi"), str(tmp_path / "o.cxi")
+    with CxiWriter(evens, max_peaks=4) as w:
+        w.append([mk(i) for i in range(0, 20, 2)])
+    with CxiWriter(odds, max_peaks=4) as w:
+        w.append([mk(i) for i in range(1, 20, 2)])
+    out = str(tmp_path / "m.cxi")
+    assert merge_cxi([evens, odds], out, chunk_events=3) == 20
+    sets = read_cxi_peaksets(out)
+    assert [p.event_idx for p in sets] == list(range(20))
+    assert all(p.y[0] == p.event_idx for p in sets)  # rows from right file
+
+    with pytest.raises(ValueError, match="chunk_events"):
+        merge_cxi([evens], str(tmp_path / "z.cxi"), chunk_events=0)
+
+
 def test_merge_cxi_cli(tmp_path):
     from psana_ray_tpu.models.peaks import CxiWriter, PeakSet, merge_cxi_main, read_cxi_peaks
 

@@ -17,6 +17,11 @@ background class).
 
 Run (small, CPU-friendly):
     python examples/train_peaknet.py --steps 4
+
+Convergence scale: on the synthetic oracle this recipe saturates peak
+recall/precision around ~300 steps at batch 2 (bench step sweep,
+PERF_NOTES.md r5) — the tiny defaults here demonstrate the plumbing,
+not a finished detector.
 """
 
 import argparse

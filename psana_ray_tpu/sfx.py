@@ -66,18 +66,24 @@ class SfxConfig:
 
 # Per-mode default find_peaks thresholds, keyed by s2d — calibrated on
 # the synthetic oracle's precision/recall sweep (bench _bench_unet_quality
-# on v5e-1, 16-step probe; full curves in bench_full.json):
-#   s2d=2: thr 0.5 IS the knee        -> recall ~0.9 / precision 1.000
-#          (stable across probe runs)
-#   s2d=4: the F1 knee lands at 0.7-0.8 across probe re-runs (the 16-step
-#          probe is nondeterministic; e.g. 0.8 -> recall 0.456/prec 0.478
-#          one run, 0.7 -> 0.631/0.209 another). 0.8 stays the default:
-#          0.5 gives precision ~0.13 — the r4 "unusable as measured"
-#          point — and >=0.85 collapses to zero recall
-# Even calibrated, quarter-res cannot reach indexing-grade precision:
-# treat s2d=4 as a TRIAGE / pre-filter mode (is this frame worth the
-# quality pass?), not a CXI-for-indexing producer — see README.
-DEFAULT_THRESHOLDS = {2: 0.5, 4: 0.8}
+# on v5e-1, 320-step probe; full curves in bench_full.json). With an
+# adequately trained checkpoint BOTH modes saturate the oracle across a
+# wide threshold range (s2d=4 at 320 steps: recall/precision 1.0/1.0 at
+# thr 0.3-0.5, degrading only gently above — 0.6 still scores 0.98/1.0),
+# so 0.5 is the shared default for both modes: inside the saturated
+# range, matching s2d=2's calibrated knee, with mild degradation rather
+# than a cliff on either side. Earlier rounds shipped
+# s2d=4 at 0.8 with a "triage-only" warning; a step sweep (PERF_NOTES
+# r5) showed that quarter-res precision ceiling was an UNDERTRAINING
+# artifact of the then-16-step probe (16 steps -> prec ~0.2-0.5 and an
+# unstable knee; 192 -> 0.97; 320 -> 1.00), not a resolution limit.
+# Operating guidance: with a converged checkpoint s2d=4 is a
+# full-quality operating point at 3.6x the s2d=2 throughput on the
+# shipped batch-8 basis (521 vs 146 fps, README measured table);
+# an UNDERTRAINED s2d=4 checkpoint degrades toward
+# over-prediction, so raise --peak_threshold if CXI output from an
+# early checkpoint floods downstream indexing.
+DEFAULT_THRESHOLDS = {2: 0.5, 4: 0.5}
 
 
 def infer_s2d(params, num_classes: int = 1) -> int:

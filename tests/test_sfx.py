@@ -164,6 +164,74 @@ def test_e2e_stream_to_cxi_recovers_planted_peaks(serving_ckpt, tmp_path):
     assert resumed.resume_point(0) == N_EVENTS
 
 
+def test_competing_sfx_consumers_partition_and_merge(serving_ckpt, tmp_path):
+    """The pod deployment shape: TWO SfxPipeline consumers compete on ONE
+    queue (the reference's consumer-side DP, SURVEY §2 row 22), each
+    writing its own CXI file; the dynamic partition must be disjoint and
+    exhaustive, both consumers must terminate on the shared EOS (the
+    batcher re-enqueues sibling markers), and `merge_cxi` must reassemble
+    the full run from the per-consumer files."""
+    from psana_ray_tpu.checkpoint import load_params
+    from psana_ray_tpu.config import PipelineConfig, SourceConfig, TransportConfig
+    from psana_ray_tpu.cxi import merge_cxi, read_cxi_peaks
+    from psana_ray_tpu.models.peaks import CxiWriter
+    from psana_ray_tpu.producer import ProducerRuntime
+    from psana_ray_tpu.sfx import SfxConfig, SfxPipeline
+    from psana_ray_tpu.transport.addressing import open_queue
+
+    cfg = PipelineConfig(
+        source=SourceConfig(
+            exp="synthetic", run=EVAL_RUN, num_events=N_EVENTS,
+            detector_name=DET, seed=SEED,
+        ),
+        # one EOS marker per expected consumer (reference parity,
+        # producer.py:124-125) — without this the first consumer to pop
+        # the single marker ends the stream and its sibling waits forever
+        transport=TransportConfig(num_consumers=2),
+    )
+    ProducerRuntime(cfg).run(block=False)
+    variables = load_params(serving_ckpt)
+    paths = [str(tmp_path / f"consumer{i}.cxi") for i in range(2)]
+    counts = [None, None]
+    errors = []
+
+    def consume(i):
+        try:
+            queue = open_queue(cfg.transport)
+            with CxiWriter(paths[i], max_peaks=64) as writer:
+                pipe = SfxPipeline(
+                    variables, writer, features=FEATURES,
+                    config=SfxConfig(batch_size=2),
+                )
+                counts[i] = pipe.run(queue)
+        except BaseException as e:  # surfaced in the main thread
+            errors.append((i, e))
+
+    # daemon: if EOS fan-out regresses, a consumer blocks forever in
+    # batches_from_queue — the join-timeout assertion must then fail the
+    # test rather than the stuck non-daemon thread hanging pytest exit
+    threads = [
+        threading.Thread(target=consume, args=(i,), daemon=True) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "competing consumer failed to terminate on EOS"
+    assert not errors, errors
+
+    per_consumer = [set(int(e) for e in read_cxi_peaks(p)[4]) for p in paths]
+    assert per_consumer[0] & per_consumer[1] == set(), "duplicate delivery"
+    assert per_consumer[0] | per_consumer[1] == set(range(N_EVENTS))
+    assert sum(counts) == N_EVENTS
+
+    merged = str(tmp_path / "merged.cxi")
+    assert merge_cxi(paths, merged) == N_EVENTS
+    n, *_rest, event_idx = read_cxi_peaks(merged)
+    assert len(n) == N_EVENTS
+    assert [int(e) for e in event_idx] == list(range(N_EVENTS))
+
+
 @pytest.mark.slow
 def test_sfx_cli_subprocess_over_shm(serving_ckpt, tmp_path):
     """The installed-CLI surface: a real `python -m psana_ray_tpu.sfx`

@@ -45,7 +45,7 @@ PER_CHIP_TARGET_FPS = 10_000 / 16  # v5e-16 north star, per chip
 # Artifact-survival budgets (seconds). The driver kills the whole bench at
 # some unknown timeout (round 2 died at rc=124 with zero parseable output);
 # our own watchdog must always fire first, emit the current JSON, and exit 0.
-GLOBAL_BUDGET_S = float(os.environ.get("BENCH_GLOBAL_BUDGET_S", "1800"))
+GLOBAL_BUDGET_S = float(os.environ.get("BENCH_GLOBAL_BUDGET_S", "2700"))
 HEADLINE_BUDGET_S = float(os.environ.get("BENCH_HEADLINE_BUDGET_S", "240"))
 SECTION_BUDGET_S = float(os.environ.get("BENCH_SECTION_BUDGET_S", "240"))
 # Budget rationale: a section timeout os._exit()s the whole bench (a hung
@@ -54,7 +54,10 @@ SECTION_BUDGET_S = float(os.environ.get("BENCH_SECTION_BUDGET_S", "240"))
 # + s4 compile in ~2-4 min on an empty .jax_cache); a warm full run is
 # ~8-9 min, but a COLD full run measured 18+ min on the r5 tunnel (the
 # old 1080 s global fired mid-quality-probe and forfeited every later
-# section), so the global budget now covers the cold case. The driver's
+# section), so the global budget covers the cold case WITH margin: the
+# r5 additions (320-step quality probe, trained MoE-ViT leg) put a
+# clean warm-cache run at ~25 min, so cold ≈ 35 min — 2700 s leaves
+# ~10 min of slack rather than zero. The driver's
 # own kill timeout is UNKNOWN (round 2 died at rc=124): the defense
 # there is not the budget but the emission discipline — the headline
 # prints before any diagnostic and every section re-emits, so stdout's
@@ -94,6 +97,7 @@ _FINAL = {
 _COMPACT_CAP = 1400
 _COMPACT_KEYS = (
     "watchdog_fired",
+    "sections_soft_cancelled",
     "backend_degraded",
     "smoke_mode",
     "device_calib_ms_per_frame",
@@ -110,6 +114,7 @@ _COMPACT_KEYS = (
     "device_vit_fps",
     "device_vit_accuracy",
     "device_moe_vit_fps",
+    "device_moe_vit_accuracy",
     "device_latency_operating_point",
     "device_sfx_pipeline_fps",
     "device_calib_jungfrau4M_fps",
@@ -167,42 +172,118 @@ def emit_final():
         pass  # side file is best-effort; stdout is the artifact of record
 
 
+class SectionTimeout(BaseException):
+    """Async-injected by the watchdog into the main thread when a section
+    exceeds its budget. BaseException so library-level ``except
+    Exception`` blocks inside the stalled section cannot swallow it;
+    ``run_section`` catches it explicitly and moves on."""
+
+
+# Grace between the soft cancel and the hard os._exit: long enough for a
+# tunnel hiccup to resolve (observed stalls are 1-3 min), short enough
+# that a truly dead backend still exits with the artifact intact.
+SOFT_CANCEL_GRACE_S = float(os.environ.get("BENCH_SOFT_GRACE_S", "180"))
+
+
 class Watchdog:
-    """Per-section + global deadline enforcement from a daemon thread."""
+    """Per-section + global deadline enforcement from a daemon thread.
+
+    Two-stage section enforcement (the r5e lesson: one multi-minute
+    tunnel stall inside ``device_time_ms`` tripped the latency section
+    and the old one-stage os._exit forfeited every later section even
+    though the stall would have resolved):
+
+    1. section deadline → SOFT cancel: ``PyThreadState_SetAsyncExc``
+       raises :class:`SectionTimeout` in the main thread. While the
+       thread is blocked inside a C call (the stall itself) the
+       exception is deferred by the interpreter and delivers the moment
+       the call returns — exactly when a resolved stall hands control
+       back — so the section aborts, ``run_section`` records it, and
+       every later section still runs.
+    2. soft deadline + grace → HARD exit: if the stall never resolves,
+       emit the artifact and ``os._exit`` as before.
+
+    The global deadline always hard-exits (it is the last line of
+    defense before the driver's own kill).
+    """
 
     def __init__(self):
         self._deadline = None
         self._section = None
+        self._soft_fired = False
+        # serializes enter/leave against the poller's check-and-inject so
+        # a cancel can never be aimed at a section that already left (the
+        # residual race — injection delivered between fn() returning and
+        # leave()'s pending-clear — is a mislabeled cancel, not a lost
+        # bench: the section's keys were already written)
+        self._lock = threading.Lock()
+        self._main_tid = threading.main_thread().ident
         self._global_deadline = time.monotonic() + GLOBAL_BUDGET_S
         threading.Thread(target=self._run, daemon=True).start()
 
+    def _hard_exit(self, which: str):
+        log(f"WATCHDOG: {which} — emitting final JSON and exiting")
+        _FINAL["watchdog_fired"] = self._section or "global"
+        try:
+            emit_final()
+        finally:
+            # os._exit MUST run even if the emit raises — a dead
+            # watchdog thread reinstates the hang-until-driver-kill
+            # failure mode this class exists to prevent
+            os._exit(0)
+
     def _run(self):
+        import ctypes
+
         while True:
             time.sleep(0.5)
             now = time.monotonic()
-            over_section = self._deadline is not None and now > self._deadline
-            over_global = now > self._global_deadline
-            if over_section or over_global:
-                which = (
-                    f"section {self._section!r}" if over_section else "global budget"
+            if now > self._global_deadline:
+                self._hard_exit("global budget exceeded")
+            with self._lock:
+                if self._deadline is None or now <= self._deadline:
+                    continue
+                if self._soft_fired:
+                    self._hard_exit(
+                        f"section {self._section!r} still stalled "
+                        f"{SOFT_CANCEL_GRACE_S:.0f} s after soft cancel"
+                    )
+                # stage 1: soft cancel, extend the deadline by the grace.
+                # Inside the lock: enter()/leave() cannot swap the
+                # section out from under the injection, and the grace
+                # extension cannot clobber a freshly entered section's
+                # own deadline.
+                log(
+                    f"WATCHDOG: section {self._section!r} exceeded — soft "
+                    f"cancel (SectionTimeout into main thread; hard exit in "
+                    f"{SOFT_CANCEL_GRACE_S:.0f} s if the stall never resolves)"
                 )
-                log(f"WATCHDOG: {which} exceeded — emitting final JSON and exiting")
-                _FINAL["watchdog_fired"] = self._section or "global"
-                try:
-                    emit_final()
-                finally:
-                    # os._exit MUST run even if the emit raises — a dead
-                    # watchdog thread reinstates the hang-until-driver-kill
-                    # failure mode this class exists to prevent
-                    os._exit(0)
+                self._soft_fired = True
+                self._deadline = now + SOFT_CANCEL_GRACE_S
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_long(self._main_tid), ctypes.py_object(SectionTimeout)
+                )
 
     def enter(self, name: str, budget_s: float):
-        self._section = name
-        self._deadline = time.monotonic() + budget_s
+        with self._lock:
+            self._section = name
+            self._soft_fired = False
+            self._deadline = time.monotonic() + budget_s
 
     def leave(self):
-        self._deadline = None
-        self._section = None
+        import ctypes
+
+        with self._lock:
+            self._deadline = None
+            self._section = None
+            if self._soft_fired:
+                # an injected-but-undelivered SectionTimeout would land
+                # in whatever runs next (the following section, emit) —
+                # clear the pending async exception (exc=NULL)
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_long(self._main_tid), None
+                )
+            self._soft_fired = False
 
     def remaining_s(self) -> float:
         """Seconds left before THIS section (or the global budget) fires —
@@ -266,6 +347,32 @@ def run_section(wd: Watchdog, name: str, fn, budget_s: float = SECTION_BUDGET_S)
                 raise
             log(f"{name} transient tunnel failure, retrying once: {e!r}")
             fn()
+        # leave INSIDE the try, immediately after the work: this clears
+        # any injected-but-undelivered soft cancel while SectionTimeout
+        # is still catchable here, instead of letting it land in
+        # emit_final / the next section
+        wd.leave()
+    except SectionTimeout:
+        # soft-cancelled: the stall resolved late and the watchdog's
+        # injected exception landed — record it and keep benching; the
+        # keys this section would have written are simply absent
+        log(
+            f"{name} cancelled by watchdog after its budget (tunnel "
+            f"stall resolved late) — later sections continue"
+        )
+        prior = _FINAL.get("sections_soft_cancelled", "")
+        _FINAL["sections_soft_cancelled"] = (
+            f"{prior},{name}" if prior else name
+        )
+        try:
+            # the cancel may have landed inside a device_time_ms trace
+            # window; a dangling trace would fail every later section's
+            # start_trace
+            import jax as _jax
+
+            _jax.profiler.stop_trace()
+        except Exception:
+            pass
     except Exception as e:
         log(f"{name} diagnostic skipped: {e!r}")
         if _is_backend_unavailable(e):
@@ -570,9 +677,14 @@ def main():
             "classifier-quality",
             lambda: _bench_classifier_quality(
                 jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras,
-                shared, smoke,
+                shared, smoke, wd,
             ),
-            budget_s=420.0,
+            # the ResNet TRAIN-step compile alone is ~2-3 min through the
+            # tunnel (measured; the serving re-time is a cache hit) and
+            # compile latency varies with tunnel load — 420 s left zero
+            # margin and two r5 runs lost the whole section to it. The
+            # ViT leg self-skips when the remaining budget is short.
+            budget_s=600.0,
         )
 
     # ---------------- EP consumer: MoE-ViT at detector scale -------------
@@ -581,8 +693,11 @@ def main():
             wd,
             "moe-vit",
             lambda: _bench_moe_vit(
-                jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras
+                jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras,
+                wd, smoke,
             ),
+            budget_s=480.0,  # fps + trained-accuracy leg (300 MoE steps
+            # + the train-step compile); part 2 self-skips when starved
         )
 
     # ---------------- s2d quality probe + threshold calibration ----------
@@ -917,8 +1032,70 @@ def _bench_vit(jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras, sha
     )
 
 
+def _raw_hit_batch(src, start: int, n: int):
+    """``n`` RAW frames + hit/miss labels from a ``hit_fraction`` corpus
+    (label := any planted truth rows) — the shared recipe of the
+    classifier-quality and MoE accuracy legs, so the two cannot drift."""
+    from psana_ray_tpu.config import RetrievalMode
+
+    frames, labels = [], []
+    for i in range(start, start + n):
+        data, _, truth = src.event_with_truth(i, RetrievalMode.RAW)
+        frames.append(data)
+        labels.append(1 if len(truth) else 0)
+    return np.stack(frames), np.asarray(labels, np.int32)
+
+
+def _train_hit_classifier(
+    jax, jnp, model, init_variables, calibrate, raw_batches, steps, tag,
+    aux_loss_weight=0.0,
+):
+    """ONE copy of the transformer-classifier training recipe so the
+    dense-ViT and MoE-ViT accuracy numbers stay comparable by
+    construction: warmup-cosine AdamW (a from-scratch ViT stalls at the
+    majority class without the warmup — PERF_NOTES r5), xent loss,
+    4-frame chunks pre-calibrated and device-resident so the steps run
+    at device speed rather than tunnel H2D speed. ``aux_loss_weight>0``
+    adds the sown MoE router load-balance loss (the EP training path).
+    Returns the trained variables, unboxed."""
+    import optax
+    from flax.core import meta
+
+    from psana_ray_tpu.models.losses import masked_softmax_xent
+    from psana_ray_tpu.parallel.steps import TrainState, make_train_step
+
+    sched = optax.warmup_cosine_decay_schedule(0.0, 6e-4, 20, steps, 1e-5)
+    opt = optax.adamw(sched, weight_decay=0.01)
+    tv = meta.unbox(init_variables)
+    opt_state = jax.jit(opt.init)({"params": tv["params"]})
+    state = TrainState(tv, opt_state, jnp.zeros((), jnp.int32))
+    step = make_train_step(
+        model, opt,
+        lambda lg, aux: masked_softmax_xent(lg, aux[0], aux[1]),
+        aux_loss_weight=aux_loss_weight,
+    )
+    dev = []
+    for frames, labels in raw_batches:
+        for h in range(0, len(labels), 4):
+            dev.append(
+                (calibrate(jnp.asarray(frames[h:h + 4])),
+                 jnp.asarray(labels[h:h + 4]))
+            )
+    ones4 = jnp.ones((4,), jnp.uint8)
+    loss = float("nan")
+    for s in range(steps):
+        x, lb = dev[s % len(dev)]
+        state, loss = step(state, x, (lb, ones4))
+    log(
+        f"{tag}: trained {steps} warmup-cosine steps "
+        f"(final loss {float(loss):.4f})"
+    )
+    return meta.unbox(state.variables)
+
+
 def _bench_classifier_quality(
-    jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras, shared, smoke=False
+    jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras, shared,
+    smoke=False, wd=None,
 ):
     """VERDICT r4 missing #2: evidence the classifiers CLASSIFY. Both the
     ResNet-50 flagship and the ViT train briefly on-device on the labeled
@@ -955,15 +1132,9 @@ def _bench_classifier_quality(
     src = SyntheticSource(
         num_events=1, detector_name=det, seed=7, hit_fraction=0.5
     )
-    from psana_ray_tpu.config import RetrievalMode
 
     def raw_batch(start, n):
-        frames, labels = [], []
-        for i in range(start, start + n):
-            data, _, truth = src.event_with_truth(i, RetrievalMode.RAW)
-            frames.append(data)
-            labels.append(1 if len(truth) else 0)
-        return np.stack(frames), np.asarray(labels, np.int32)
+        return _raw_hit_batch(src, start, n)
 
     calibrate = jax.jit(
         lambda f: fused_calibrate(
@@ -995,6 +1166,9 @@ def _bench_classifier_quality(
         return state
 
     def accuracy_and_fps(infer2, variables, tag, b_fps, eval_chunk=None):
+        # load_params hands back host numpy; place it once so the eval +
+        # re-time dispatches don't re-upload the tree over the tunnel
+        variables = jax.device_put(variables)
         ec = eval_chunk or b
         pred = []
         for s in range(0, n_eval, ec):
@@ -1039,34 +1213,27 @@ def _bench_classifier_quality(
     # device ONCE so the 300 steps run at device speed (~80 s), not H2D
     # speed. The conv net above needs no such treatment — worth recording.
     if shared.get("vit_infer") is not None and not smoke:
+        # entering the ViT leg costs its train-step compile + 300 steps +
+        # the trained re-time; with less than ~240 s left that guarantees
+        # a mid-leg section deadline (os._exit forfeits every later
+        # section) — skip and keep the ResNet keys just recorded
+        if wd is not None and wd.remaining_s() < 240.0:
+            log(
+                f"vit accuracy: skipped ({wd.remaining_s():.0f} s left "
+                f"< 240 s reserve); fps-section number stands"
+            )
+            extras["device_vit_probe_skipped"] = True
+            return
         model = ViTHitClassifier(num_classes=2)
         vit_steps = 300
-        sched = optax.warmup_cosine_decay_schedule(0.0, 6e-4, 20, vit_steps, 1e-5)
-        opt = optax.adamw(sched, weight_decay=0.01)
-        variables = meta.unbox(
-            host_init(model, (1, *train_batches[0][0].shape[1:]))
+        trained_vars = _train_hit_classifier(
+            jax, jnp, model,
+            host_init(model, (1, *train_batches[0][0].shape[1:])),
+            calibrate, train_batches, vit_steps, "vit",
         )
-        opt_state = jax.jit(opt.init)({"params": variables["params"]})
-        state = TrainState(variables, opt_state, jnp.zeros((), jnp.int32))
-        step = make_train_step(model, opt, loss_fn)
-        dev = []
-        for frames, labels in train_batches:
-            for h in range(0, len(labels), 4):
-                dev.append(
-                    (calibrate(jnp.asarray(frames[h:h + 4])),
-                     jnp.asarray(labels[h:h + 4]))
-                )
-        ones4 = jnp.ones((4,), jnp.uint8)
-        loss = float("nan")
-        for s in range(vit_steps):
-            x, lb = dev[s % len(dev)]
-            state, loss = step(state, x, (lb, ones4))
-        log(f"vit: trained {vit_steps} warmup-cosine steps "
-            f"(final loss {float(loss):.4f})")
-        del dev
         path = tempfile.mkdtemp(prefix="bench_trained_vit_")
         shutil.rmtree(path)
-        save_params(path, meta.unbox(state.variables))
+        save_params(path, trained_vars)
         trained = load_params(path)
         shutil.rmtree(path, ignore_errors=True)
         accuracy_and_fps(shared["vit_infer"], trained, "vit", 2, eval_chunk=2)
@@ -1083,36 +1250,118 @@ def _bench_classifier_quality(
         extras["smoke_classifier_labels"] = [int(x) for x in labels[0]]
 
 
-def _bench_moe_vit(jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras):
+def _bench_moe_vit(
+    jax, jnp, pedestal, gain, mask, x_warm, x_fresh_list, extras, wd=None,
+    smoke=False,
+):
     """EP consumer at detector scale (VERDICT r4 do #5): the 8,448-token
     ViT with every block's MLP a 4-expert switch MoE. Servable on one
     chip only because of grouped dispatch (parallel/moe.py): the
     monolithic [B, T, E, C] dispatch at this shape is ~1.1 GB f32 PER
-    LAYER; grouped (auto G=384) it is ~26 MB. Random weights — the fps
-    does not depend on values; the router still routes."""
+    LAYER; grouped (auto G=384) it is ~26 MB.
+
+    Two parts, fps first so a budget-starved run still records the EP
+    throughput story: (1) the compiled calib+MoE-ViT serving step timed
+    on random weights (throughput does not depend on values; the router
+    still routes); (2) the accuracy story — the MoE-ViT trains on the
+    same labeled hit/miss corpus as the dense classifiers (classifier-
+    quality section), with the router's load-balance aux loss active
+    (make_train_step(aux_loss_weight=0.01), the supported EP training
+    path), round-trips through save_params/load_params, and the serving
+    step is re-timed on the trained checkpoint — so, like ResNet-50 and
+    the dense ViT, the judged fps and accuracy describe the same
+    weights."""
+    import shutil
+
+    from psana_ray_tpu.checkpoint import load_params, save_params
     from psana_ray_tpu.models import ViTHitClassifier, host_init
     from psana_ray_tpu.ops import fused_calibrate
+    from psana_ray_tpu.sources import SyntheticSource
 
     b = 2
     model = ViTHitClassifier(num_classes=2, moe_experts=4)
     variables = host_init(model, (1, *x_warm.shape[1:]))
 
-    @jax.jit
-    def infer(frames):
-        c = fused_calibrate(
-            frames, pedestal, gain, mask, threshold=10.0, out_dtype=jnp.bfloat16
+    calibrate = jax.jit(
+        lambda f: fused_calibrate(
+            f, pedestal, gain, mask, threshold=10.0, out_dtype=jnp.bfloat16
         )
-        return jnp.argmax(model.apply(variables, c), -1)
+    )
+
+    @jax.jit
+    def infer2(v, frames):
+        return jnp.argmax(model.apply(v, calibrate(frames)), -1)
 
     x = x_fresh_list[0]
     samples = [(x[k * b:(k + 1) * b],) for k in range(min(3, len(x) // b))]
-    ms = device_time_ms(jax, infer, (x_warm[:b],), samples, "calib+MoE-ViT", extras)
+    ms = device_time_ms(
+        jax, lambda f: infer2(variables, f), (x_warm[:b],), samples,
+        "calib+MoE-ViT", extras,
+    )
     extras["device_moe_vit_fps"] = round(b / (ms / 1e3), 1)
     log(
         f"calib+MoE-ViT (4-expert switch MLPs, grouped dispatch): "
         f"{ms:.1f} ms / {b} frames device-time -> "
         f"{extras['device_moe_vit_fps']:.1f} fps"
     )
+
+    # ---- part 2: train with the router aux loss, score, re-time ---------
+    # The MoE train-step compile is the expensive unknown on a slow
+    # tunnel; entering with less than ~300 s (more reserve than the
+    # dense ViT's 240 s — this leg does strictly more: MoE compile,
+    # save/load round trip, re-time) guarantees tripping the section
+    # deadline mid-compile, so skip and keep the fps number.
+    # Smoke validates the fps plumbing only: the corpus below is real
+    # epix10k2M and the 300-step detector-scale MoE train does not
+    # belong on the 1-core CPU host.
+    if smoke:
+        return
+    if wd is not None and wd.remaining_s() < 300.0:
+        log(
+            f"moe_vit accuracy: skipped ({wd.remaining_s():.0f} s left "
+            f"< 300 s compile reserve); random-weight fps stands"
+        )
+        extras["device_moe_vit_probe_skipped"] = True
+        return
+    src = SyntheticSource(
+        num_events=1, detector_name="epix10k2M", seed=7, hit_fraction=0.5
+    )
+
+    def raw_batch(start, n):
+        return _raw_hit_batch(src, start, n)
+
+    n_eval, moe_steps = 16, 300
+    trained_vars = _train_hit_classifier(
+        jax, jnp, model,
+        variables,  # part 1's init IS this leg's init tree
+        calibrate, [raw_batch(s * 8, 8) for s in range(10)], moe_steps,
+        "moe_vit (router aux loss on)", aux_loss_weight=0.01,
+    )
+    path = tempfile.mkdtemp(prefix="bench_trained_moe_")
+    shutil.rmtree(path)
+    save_params(path, trained_vars)
+    # device_put once: load_params returns host numpy, and passing that
+    # to jit re-uploads the detector-scale tree over the tunnel on EVERY
+    # eval/re-time dispatch
+    trained = jax.device_put(load_params(path))
+    shutil.rmtree(path, ignore_errors=True)
+    eval_frames, eval_labels = raw_batch(5000, n_eval)
+    pred = []
+    for s in range(0, n_eval, b):
+        pred.append(np.asarray(infer2(trained, jnp.asarray(eval_frames[s:s + b]))))
+    acc = float((np.concatenate(pred) == eval_labels).mean())
+    extras["device_moe_vit_accuracy"] = round(acc, 3)
+    ms = device_time_ms(
+        jax, lambda f: infer2(trained, f), (x_warm[:b],), samples,
+        "moe-vit-trained", extras,
+    )
+    extras["device_moe_vit_fps"] = round(b / (ms / 1e3), 1)
+    extras.setdefault("serving_params_source", {})["moe_vit"] = (
+        f"TRAINED {moe_steps} steps (aux_loss_weight=0.01) on hit/miss "
+        f"corpus -> save_params -> load_params"
+    )
+    log(f"moe_vit TRAINED checkpoint: accuracy {acc:.3f} on {n_eval} "
+        f"held-out events, {extras['device_moe_vit_fps']:.1f} fps (re-timed)")
 
 
 def _bench_jungfrau_calib(jax, jnp, epix_calib, epix_x_list, extras, smoke=False):
@@ -1830,4 +2079,13 @@ def _bench_fanin_device(jax, jnp, pool, pedestal, gain, mask, extras, smoke=Fals
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SectionTimeout:
+        # a soft cancel that landed outside any run_section (headline /
+        # jax-init / between sections): keep whatever the artifact holds
+        log("watchdog cancel escaped a section boundary — emitting as-is")
+        emit_final()
+    except BaseException:
+        emit_final()
+        raise

@@ -330,58 +330,66 @@ def run_section(wd: Watchdog, name: str, fn, budget_s: float = SECTION_BUDGET_S)
     wd.enter(name, budget_s)
     backend_dead = False
     try:
-        t0 = time.monotonic()
         try:
-            fn()
+            t0 = time.monotonic()
+            try:
+                fn()
+            except Exception as e:
+                took = time.monotonic() - t0
+                # the failed attempt's duration LOWER-bounds a successful
+                # retry (the exception aborted it early), so demand budget
+                # for twice that and never less than 90 s — tripping the
+                # watchdog mid-retry forfeits every later section
+                if (
+                    not _is_transient_tunnel_error(e)
+                    or _is_backend_unavailable(e)
+                    or wd.remaining_s() < max(2.0 * took, 90.0)
+                ):
+                    raise
+                log(f"{name} transient tunnel failure, retrying once: {e!r}")
+                fn()
+            # leave INSIDE the try, immediately after the work: this
+            # clears any injected-but-undelivered soft cancel while
+            # SectionTimeout is still catchable here, instead of letting
+            # it land in emit_final / the next section
+            wd.leave()
+        except SectionTimeout:
+            _note_soft_cancel(name)
         except Exception as e:
-            took = time.monotonic() - t0
-            # the failed attempt's duration LOWER-bounds a successful
-            # retry (the exception aborted it early), so demand budget
-            # for twice that and never less than 90 s — tripping the
-            # watchdog mid-retry forfeits every later section
-            if (
-                not _is_transient_tunnel_error(e)
-                or _is_backend_unavailable(e)
-                or wd.remaining_s() < max(2.0 * took, 90.0)
-            ):
-                raise
-            log(f"{name} transient tunnel failure, retrying once: {e!r}")
-            fn()
-        # leave INSIDE the try, immediately after the work: this clears
-        # any injected-but-undelivered soft cancel while SectionTimeout
-        # is still catchable here, instead of letting it land in
-        # emit_final / the next section
-        wd.leave()
+            log(f"{name} diagnostic skipped: {e!r}")
+            if _is_backend_unavailable(e):
+                _FINAL["backend_degraded"] = True
+                backend_dead = True
+        finally:
+            wd.leave()
     except SectionTimeout:
-        # soft-cancelled: the stall resolved late and the watchdog's
-        # injected exception landed — record it and keep benching; the
-        # keys this section would have written are simply absent
-        log(
-            f"{name} cancelled by watchdog after its budget (tunnel "
-            f"stall resolved late) — later sections continue"
-        )
-        prior = _FINAL.get("sections_soft_cancelled", "")
-        _FINAL["sections_soft_cancelled"] = (
-            f"{prior},{name}" if prior else name
-        )
-        try:
-            # the cancel may have landed inside a device_time_ms trace
-            # window; a dangling trace would fail every later section's
-            # start_trace
-            import jax as _jax
-
-            _jax.profiler.stop_trace()
-        except Exception:
-            pass
-    except Exception as e:
-        log(f"{name} diagnostic skipped: {e!r}")
-        if _is_backend_unavailable(e):
-            _FINAL["backend_degraded"] = True
-            backend_dead = True
-    finally:
+        # the single in-flight cancel delivered INSIDE a handler or the
+        # finally above (injected pre-leave, raised mid-unwind) — same
+        # treatment, so it cannot escape run_section and abort the bench.
+        # The watchdog injects at most once per section (soft_fired), so
+        # one outer net is exhaustive.
+        _note_soft_cancel(name)
         wd.leave()
     emit_final()
     return backend_dead
+
+
+def _note_soft_cancel(name: str):
+    """Record a watchdog soft cancel and clean up anything the cancelled
+    section may have left dangling (an open profiler trace would fail
+    every later section's start_trace)."""
+    log(
+        f"{name} cancelled by watchdog after its budget (tunnel "
+        f"stall resolved late) — later sections continue"
+    )
+    prior = _FINAL.get("sections_soft_cancelled", "")
+    _FINAL["sections_soft_cancelled"] = f"{prior},{name}" if prior else name
+    try:
+        import jax as _jax
+
+        _jax.profiler.stop_trace()
+    except Exception:
+        pass
 
 
 def _parse_all_device_module_durs(trace_dir: str):

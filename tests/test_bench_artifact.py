@@ -1,0 +1,128 @@
+"""bench.py artifact + watchdog machinery regression tests.
+
+Two real failures drove these defenses and must never come back:
+
+- Round 4's driver artifact was unparseable (``BENCH_r04.json:
+  parsed=null``) because the final JSON line outgrew the driver's tail
+  window — the compact final line is now hard-capped and self-checked.
+- Two round-5 full-bench runs were forfeited by one-stage section
+  watchdogs ``os._exit``-ing on transient multi-minute tunnel stalls —
+  a section overrun now soft-cancels (async ``SectionTimeout`` into the
+  main thread) so later sections still run, with the hard exit reserved
+  for stalls that outlive the grace period.
+
+These tests run the REAL machinery (real Watchdog thread, real
+``run_section``) on fake sections; no jax/TPU involved. ``bench_full.json``
+writes land in the repo root but the file is gitignored and regenerated
+by every bench run.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+@pytest.fixture
+def fresh_final():
+    """Snapshot/restore the module-global artifact dict around each test."""
+    snap = dict(bench._FINAL)
+    yield bench._FINAL
+    bench._FINAL.clear()
+    bench._FINAL.update(snap)
+
+
+def test_compact_line_parseable_and_capped_under_adversarial_growth(fresh_final):
+    """The r4 regression: no matter how large the extras dict grows, the
+    final emitted line must parse and stay under the hard cap."""
+    f = bench._FINAL
+    f["value"] = 28000.5
+    f["vs_baseline"] = 44.8
+    for i in range(500):  # ~50 KB of junk keys — far past the cap
+        f[f"device_bulk_diag_{i}"] = [round(i * 0.1, 3)] * 40
+    line = bench._compact_line()
+    d = json.loads(line)  # must parse
+    assert len(line) <= bench._COMPACT_CAP + 1  # +1: trailing newline
+    # the four headline fields always survive
+    assert d["metric"] == bench._FINAL["metric"]
+    assert d["value"] == 28000.5
+    assert d["unit"] == "frames/s"
+    assert d["vs_baseline"] == 44.8
+
+
+def test_compact_line_prefers_judged_keys_over_bulk(fresh_final):
+    f = bench._FINAL
+    for i in range(500):
+        f[f"device_bulk_diag_{i}"] = [i] * 40
+    # priority keys added AFTER the junk must still make the line
+    f["device_resnet50_accuracy"] = 1.0
+    f["device_unet_recall"] = 0.99
+    d = json.loads(bench._compact_line())
+    assert d["device_resnet50_accuracy"] == 1.0
+    assert d["device_unet_recall"] == 0.99
+    assert not any(k.startswith("device_bulk_diag_") for k in d)
+
+
+def test_stalled_section_soft_cancels_and_later_sections_run(fresh_final):
+    """The r5 tunnel-stall scenario: a section blocked past its budget in
+    resumable work is cancelled in place; the sections after it run and
+    the cancel is recorded in the artifact."""
+    wd = bench.Watchdog()
+    hit = {}
+
+    def stalls():
+        for _ in range(600):  # a 60 s "stall" in interruptible slices
+            time.sleep(0.1)
+        raise AssertionError("watchdog never cancelled the stall")
+
+    def later():
+        hit["later"] = True
+
+    t0 = time.monotonic()
+    assert bench.run_section(wd, "fake-stall", stalls, budget_s=1.5) is False
+    assert time.monotonic() - t0 < 30.0  # cancelled, not run to completion
+    assert bench.run_section(wd, "fake-later", later, budget_s=30.0) is False
+    assert hit.get("later") is True
+    assert "fake-stall" in bench._FINAL["sections_soft_cancelled"]
+    assert "fake-later" not in bench._FINAL.get("sections_soft_cancelled", "")
+
+
+def test_near_deadline_completion_does_not_poison_next_section(fresh_final):
+    """A section finishing right around its deadline must not leave a
+    stale cancel that aborts the (healthy, in-budget) next section."""
+    wd = bench.Watchdog()
+    ran = {}
+
+    def near_deadline():
+        time.sleep(1.4)  # budget 1.5 s, watchdog polls every 0.5 s
+
+    def healthy():
+        ran["healthy"] = True
+
+    bench.run_section(wd, "fake-near", near_deadline, budget_s=1.5)
+    bench.run_section(wd, "fake-healthy", healthy, budget_s=30.0)
+    assert ran.get("healthy") is True
+    assert "fake-healthy" not in bench._FINAL.get("sections_soft_cancelled", "")
+
+
+def test_section_exception_is_contained(fresh_final):
+    """A failing diagnostic never sinks the artifact or later sections
+    (reference behavior: errors become recorded skips, not stalls)."""
+    wd = bench.Watchdog()
+    ran = {}
+
+    def boom():
+        raise RuntimeError("diagnostic broke")
+
+    def later():
+        ran["later"] = True
+
+    assert bench.run_section(wd, "fake-boom", boom, budget_s=30.0) is False
+    bench.run_section(wd, "fake-after-boom", later, budget_s=30.0)
+    assert ran.get("later") is True

@@ -94,7 +94,9 @@ def infer_s2d(params, num_classes: int = 1) -> int:
     property of the TRAINED tree, not something the operator must
     remember to pass consistently."""
     try:
-        out_ch = int(np.shape(params["logits"]["kernel"])[-1])
+        kern = params["logits"]["kernel"]
+        kern = getattr(kern, "value", kern)  # unbox LogicallyPartitioned
+        out_ch = int(np.shape(kern)[-1])
     except (KeyError, TypeError) as e:
         raise ValueError(
             "params tree has no logits/kernel leaf — is this a PeakNetUNetTPU "
@@ -109,26 +111,58 @@ def infer_s2d(params, num_classes: int = 1) -> int:
     return s2d
 
 
+def infer_features(params) -> Tuple[int, ...]:
+    """Read the encoder widths out of a serving checkpoint: ``ConvBlock_i``'s
+    first conv emits ``features[i]`` channels (encoder blocks ``0..n-2``
+    plus the bottleneck ``n-1`` — models/unet_tpu.py builds them in that
+    order, so flax's auto-numbering IS the features index). Like the s2d
+    factor, the widths are a property of the TRAINED tree — the CLI's
+    ``--features`` is a cross-check, not something the operator must keep
+    in sync by hand."""
+    widths = []
+    while True:
+        blk = params.get(f"ConvBlock_{len(widths)}") if hasattr(params, "get") else None
+        if blk is None:
+            break
+        try:
+            kern = blk["Conv_0"]["kernel"]
+        except (KeyError, TypeError) as e:
+            raise ValueError(
+                f"ConvBlock_{len(widths)} has no Conv_0/kernel leaf — is this "
+                f"a PeakNetUNetTPU serving checkpoint?"
+            ) from e
+        kern = getattr(kern, "value", kern)  # unbox LogicallyPartitioned
+        widths.append(int(np.shape(kern)[-1]))
+    if not widths:
+        raise ValueError(
+            "params tree has no ConvBlock_0 — is this a PeakNetUNetTPU "
+            "serving checkpoint (export_serving_params output)?"
+        )
+    return tuple(widths)
+
+
 class SfxPipeline:
     """The assembled stream->CXI serving loop.
 
     ``variables`` is the ``norm='frozen'`` serving tree
     (:func:`~psana_ray_tpu.models.fold.export_serving_params` output,
     loaded back with :func:`~psana_ray_tpu.checkpoint.load_params`);
-    the s2d operating mode is inferred from it. ``calib`` is an optional
-    ``(pedestal, gain, mask)`` triple of ``[P, H, W]`` arrays — give it
-    when the stream carries RAW ADUs; omit it for producer-calibrated
-    (``--calib``) streams.
+    the s2d operating mode AND encoder widths are inferred from it.
+    ``calib`` is an optional ``(pedestal, gain, mask)`` triple of
+    ``[P, H, W]`` arrays — give it when the stream carries RAW ADUs; omit
+    it for producer-calibrated (``--calib``) streams.
 
-    ``features`` must match the checkpoint (the apply fails loudly on a
-    mismatch, so a wrong flag cannot produce silent garbage).
+    ``features=None`` (default) infers the widths from the checkpoint;
+    an explicit tuple is cross-checked against the tree and refused on
+    mismatch (an early clear error instead of a shape failure deep in
+    the first apply).
     """
 
     def __init__(
         self,
         variables,
         writer,
-        features: Tuple[int, ...] = (64, 128, 256, 512),
+        features: Optional[Tuple[int, ...]] = None,
         calib: Optional[tuple] = None,
         config: Optional[SfxConfig] = None,
     ):
@@ -140,9 +174,16 @@ class SfxPipeline:
         self.writer = writer
         params = variables.get("params", variables)
         self.s2d = infer_s2d(params)
+        self.features = infer_features(params)
+        if features is not None and tuple(features) != self.features:
+            raise ValueError(
+                f"features={tuple(features)} does not match the checkpoint "
+                f"(trained with {self.features}); the widths are a property "
+                f"of the tree — drop the explicit features/--features"
+            )
         self._variables = {"params": params}
         self._model = PeakNetUNetTPU(
-            features=tuple(features), norm="frozen", s2d=self.s2d
+            features=self.features, norm="frozen", s2d=self.s2d
         )
         self._calib = None
         if calib is not None:
@@ -335,8 +376,9 @@ def main(argv=None):
         "checkpoint",
     )
     ap.add_argument(
-        "--features", default="64,128,256,512",
-        help="comma-separated encoder widths; must match the checkpoint",
+        "--features", default="auto",
+        help="comma-separated encoder widths as a cross-check against the "
+        "checkpoint (default: inferred from it, like the s2d mode)",
     )
     ap.add_argument(
         "--calib_npz", default=None,
@@ -411,6 +453,25 @@ def main(argv=None):
         return 1
     if a.peak_threshold is None:
         a.peak_threshold = DEFAULT_THRESHOLDS.get(s2d, 0.5)
+    if a.features != "auto":
+        try:
+            features = tuple(int(f) for f in a.features.split(","))
+        except ValueError:
+            log.error(
+                "--features %r is not a comma-separated integer list "
+                "(or the default 'auto')", a.features,
+            )
+            return 1
+        trained = infer_features(variables.get("params", variables))
+        if features != trained:
+            # same fail-fast shape as the --mode check: refuse before any
+            # transport wait, not after the queue rendezvous
+            log.error(
+                "--features %s does not match checkpoint %s (trained with "
+                "%s); the widths are a property of the tree — drop --features",
+                a.features, a.serving_params, ",".join(map(str, trained)),
+            )
+            return 1
 
     calib = None
     if a.calib_npz:
@@ -444,7 +505,6 @@ def main(argv=None):
         log.error("could not open queue %s: %s", a.queue_name, e)
         return 1
 
-    features = tuple(int(f) for f in a.features.split(","))
     sfx_cfg = SfxConfig(
         batch_size=a.batch, peak_threshold=a.peak_threshold,
         max_peaks=a.panel_max_peaks, min_distance=a.min_distance,
@@ -472,8 +532,10 @@ def main(argv=None):
             return 1
     try:
         with CxiWriter(a.output, max_peaks=a.max_peaks, mode=writer_mode) as writer:
+            # features already cross-checked above (one source of truth:
+            # the constructor's check is for library callers)
             pipe = SfxPipeline(
-                variables, writer, features=features, calib=calib, config=sfx_cfg
+                variables, writer, calib=calib, config=sfx_cfg
             )
             import time
 

@@ -117,6 +117,28 @@ def test_infer_s2d_reads_checkpoint(serving_ckpt):
         infer_s2d({"not": "a tree"})
 
 
+def test_infer_features_reads_checkpoint(serving_ckpt):
+    from psana_ray_tpu.checkpoint import load_params
+    from psana_ray_tpu.sfx import infer_features
+
+    v = load_params(serving_ckpt)
+    assert infer_features(v.get("params", v)) == FEATURES
+    with pytest.raises(ValueError, match="ConvBlock_0"):
+        infer_features({"not": "a tree"})
+
+
+def test_features_mismatch_refused(serving_ckpt, tmp_path):
+    """An explicit features tuple that contradicts the checkpoint is an
+    early clear refusal, not a shape error deep in the first apply."""
+    from psana_ray_tpu.checkpoint import load_params
+    from psana_ray_tpu.cxi import CxiWriter
+    from psana_ray_tpu.sfx import SfxPipeline
+
+    with CxiWriter(str(tmp_path / "x.cxi")) as w:
+        with pytest.raises(ValueError, match="does not match the checkpoint"):
+            SfxPipeline(load_params(serving_ckpt), w, features=(4, 8))
+
+
 def test_e2e_stream_to_cxi_recovers_planted_peaks(serving_ckpt, tmp_path):
     """The full library-surface pipeline: ProducerRuntime streaming
     held-out synthetic events -> queue -> SfxPipeline -> CXI file whose

@@ -126,3 +126,35 @@ def test_section_exception_is_contained(fresh_final):
     assert bench.run_section(wd, "fake-boom", boom, budget_s=30.0) is False
     bench.run_section(wd, "fake-after-boom", later, budget_s=30.0)
     assert ran.get("later") is True
+
+
+def test_soft_cancel_grace_adapts_to_global_headroom(fresh_final):
+    """The r5 tunnel-outage lesson: with global budget to spare, the
+    post-soft-cancel grace rides out the stall (up to the cap) instead
+    of exiting at the fixed floor; with the global deadline near, it
+    stays at the floor so the clean exit still beats the global fire."""
+    watchdogs = []
+
+    def stalls():
+        for _ in range(600):
+            time.sleep(0.1)
+
+    try:
+        wd = bench.Watchdog()
+        watchdogs.append(wd)
+        bench.run_section(wd, "fake-grace-rich", stalls, budget_s=1.0)
+        # fresh watchdog: the full global budget of headroom
+        assert wd._grace_s == bench.ADAPTIVE_GRACE_CAP_S
+
+        wd2 = bench.Watchdog()
+        watchdogs.append(wd2)
+        # headroom below floor + margin -> the floor wins, not ~80 s
+        wd2._global_deadline = time.monotonic() + 200.0
+        bench.run_section(wd2, "fake-grace-poor", stalls, budget_s=1.0)
+        assert wd2._grace_s == bench.SOFT_CANCEL_GRACE_S
+    finally:
+        # leaked poller threads live until process exit; push their
+        # global deadlines out so none can os._exit(0) mid-suite and
+        # silently truncate a green pytest run
+        for w in watchdogs:
+            w._global_deadline = time.monotonic() + 10**9

@@ -261,3 +261,36 @@ class TestExpertParallel:
             {k: v for k, v in state.variables["params"].items()}
         )
         assert all(np.isfinite(np.asarray(jax.device_get(l))).all() for l in w_up)
+
+
+def test_serving_capacity_factor_is_trace_time_only():
+    """The serving-side capacity trick (bench: train at cf=2.0, serve at
+    cf=1.25 for ~10% fps): expert capacity is a trace-time constant, so
+    one trained tree must apply unchanged under ANY capacity factor, and
+    with capacity >= tokens/expert-worst-case the outputs must agree
+    exactly (no token ever dropped at either setting)."""
+    rng = np.random.default_rng(3)
+    kw = dict(patch=8, embed_dim=64, depth=2, num_heads=4, num_classes=2,
+              dtype=jnp.float32, moe_experts=2)
+    train_model = ViTHitClassifier(moe_capacity_factor=2.0, **kw)
+    frames = jnp.asarray(rng.normal(size=(2, 2, 16, 32)).astype(np.float32))
+    variables = nn_meta.unbox(train_model.init(jax.random.key(0), frames))
+
+    # two NO-DROP capacities (cap=t vs cap=2t — cf=E and cf=2E): different
+    # dispatch-tensor shapes, same routing outcome, so outputs must agree
+    # exactly — proves capacity changes only the trace, and the padded
+    # capacity slots' garbage never leaks into the combine. With E=2 the
+    # first config equals train_model's cf=2.0, so it doubles as the
+    # train-setting output
+    e = float(kw["moe_experts"])
+    out_nd1 = train_model.apply(variables, frames)  # cf=2.0 == cf=E here
+    out_nd2 = ViTHitClassifier(moe_capacity_factor=2 * e, **kw).apply(variables, frames)
+    np.testing.assert_allclose(
+        np.asarray(out_nd1), np.asarray(out_nd2), rtol=1e-5, atol=1e-5
+    )
+    # the shipped train/serve settings: the cf=2.0 tree applies unchanged
+    # at cf=1.25, right shape, finite (drops fall back to the residual)
+    serve = ViTHitClassifier(moe_capacity_factor=1.25, **kw)
+    out_lo = serve.apply(variables, frames)
+    assert out_lo.shape == out_nd1.shape
+    assert np.isfinite(np.asarray(out_lo)).all()

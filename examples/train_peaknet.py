@@ -51,7 +51,26 @@ def main():
         "parameter form peaknet_tpu_fused_infer consumes. Implies --norm "
         "batch.",
     )
+    ap.add_argument(
+        "--features", default="16,32",
+        help="comma-separated encoder widths (default keeps the example "
+        "CPU-fast; 64,128,256,512 is the real PeakNet-TPU capacity the "
+        "bench and psana-ray-tpu-sfx serve). The exported checkpoint "
+        "carries the widths — sfx infers them back, no flag to keep in "
+        "sync.",
+    )
+    ap.add_argument(
+        "--s2d", type=int, default=2, choices=[2, 4],
+        help="space-to-depth factor: 2 = quality mode, 4 = throughput "
+        "mode (the operating point is baked into the trained tree; "
+        "psana-ray-tpu-sfx reads it from the checkpoint)",
+    )
     args = ap.parse_args()
+    try:
+        args.features = tuple(int(f) for f in args.features.split(","))
+    except ValueError:
+        ap.error(f"--features {args.features!r} is not a comma-separated "
+                 f"integer list")
     if args.export_serving:
         args.norm = "batch"
 
@@ -92,9 +111,9 @@ def main():
     mask = jnp.asarray(src.create_bad_pixel_mask())
     n_panels, h, w = src.spec.frame_shape
 
-    # small model so the example trains in seconds on CPU; scale features
-    # to (64, 128, 256, 512) for the real PeakNet-TPU capacity
-    model = PeakNetUNetTPU(features=(16, 32), norm=args.norm)
+    # default widths keep the example training in seconds on CPU;
+    # --features 64,128,256,512 is the real PeakNet-TPU capacity
+    model = PeakNetUNetTPU(features=args.features, norm=args.norm, s2d=args.s2d)
 
     def labels_of(frames_nhwc):
         # stand-in ground truth: calibrated intensity over threshold.

@@ -53,7 +53,9 @@ def make_step(detector: str):
     """One compiled calibration step per detector geometry."""
     src = SyntheticSource(num_events=1, detector_name=detector, seed=0)
     ped = np.asarray(src.pedestal())
-    gain = np.asarray(src.gain_map())
+    # absolute gain (ADUs/photon): photons out of the calibrate step —
+    # the relative map alone would leave output 35x hot (see gain_map())
+    gain = np.asarray(src.spec.adu_gain * src.gain_map())
     mask = np.asarray(src.create_bad_pixel_mask())
     step = jax.jit(lambda f: fused_calibrate(f, ped, gain, mask, threshold=10.0))
     return lambda batch: step(batch.frames)

@@ -89,6 +89,13 @@ class SyntheticSource:
         return self._pedestal
 
     def gain_map(self) -> np.ndarray:
+        """Per-pixel RELATIVE gain (mean 1.0). Raw-mode ADUs carry
+        ``spec.adu_gain`` ADUs/photon on top of this map, so the gain
+        array that takes a raw frame back to PHOTONS is
+        ``spec.adu_gain * gain_map()`` — passing the relative map alone
+        to ``ops.calibrate`` yields ADU-scaled output, 35x hotter than
+        the calib-mode stream (a real mislabeling trap for photon-scale
+        thresholds; see examples/train_peaknet.py)."""
         if self._gain_map is None:
             rng = np.random.default_rng(self._seed ^ 0x6A1)
             self._gain_map = (

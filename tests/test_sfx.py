@@ -537,3 +537,56 @@ def test_cxi_append_refuses_foreign_hdf5(tmp_path):
     # handle released: the file can be reopened for writing immediately
     with h5py.File(path, "r+") as f:
         assert "something_else" in f
+
+
+def test_raw_stream_with_on_device_calibration(serving_ckpt, tmp_path):
+    """The --calib_npz serving shape: the stream carries RAW ADUs and the
+    compiled step runs fused calibration in FRONT of the net. Pins the
+    gain convention — the npz gain is ABSOLUTE (ADUs/photon, i.e.
+    spec.adu_gain * relative map): with it, peaks recover the planted
+    truth like the calib-stream path; the relative map alone would feed
+    the net 35x-hot frames (the examples/train_peaknet.py trap)."""
+    from psana_ray_tpu.checkpoint import load_params
+    from psana_ray_tpu.config import PipelineConfig, SourceConfig
+    from psana_ray_tpu.models.peaks import CxiWriter
+    from psana_ray_tpu.producer import ProducerRuntime
+    from psana_ray_tpu.sfx import SfxConfig, SfxPipeline
+    from psana_ray_tpu.sources import SyntheticSource
+    from psana_ray_tpu.sources.base import DETECTORS
+    from psana_ray_tpu.transport.addressing import open_queue
+
+    # calibration constants from the SAME run as the stream: pedestal /
+    # gain / mask are seeded per (exp, run, seed), so a default run=1
+    # source would calibrate run-2 frames with mismatched constants
+    src = SyntheticSource(run=EVAL_RUN, num_events=1, detector_name=DET, seed=SEED)
+    calib = (
+        src.pedestal(),
+        src.spec.adu_gain * src.gain_map(),  # ABSOLUTE gain -> photons out
+        src.create_bad_pixel_mask(),
+    )
+
+    cfg = PipelineConfig(
+        source=SourceConfig(
+            exp="synthetic", run=EVAL_RUN, num_events=N_EVENTS,
+            detector_name=DET, seed=SEED, mode="raw",
+        )
+    )
+    ProducerRuntime(cfg).run(block=False)
+    queue = open_queue(cfg.transport)
+
+    cxi = str(tmp_path / "raw.cxi")
+    variables = load_params(serving_ckpt)
+    with CxiWriter(cxi, max_peaks=64) as writer:
+        pipe = SfxPipeline(
+            variables, writer, calib=calib, config=SfxConfig(batch_size=4),
+        )
+        n = pipe.run(queue)
+    assert n == N_EVENTS
+
+    h = DETECTORS[DET].height
+    m, events = _score_cxi(cxi, h)
+    assert events == set(range(N_EVENTS))
+    # same physics bar as the calib-stream e2e: the on-device chain must
+    # hand the net the same photon-scale distribution it trained on
+    assert m["recall"] >= 0.6, m
+    assert m["precision"] >= 0.8, m

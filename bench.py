@@ -1572,7 +1572,8 @@ def _bench_e2e_streaming(jax, calib, pool, batch_size, extras, wd=None):
     consumer pipeline's p50/p99 step latency."""
     from psana_ray_tpu.infeed import InfeedPipeline
     from psana_ray_tpu.infeed.batcher import batches_from_queue
-    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.obs.stages import HOP_ENQ, HOP_SRC
+    from psana_ray_tpu.records import EndOfStream, FrameRecord, mark_hop
 
     try:
         from psana_ray_tpu.transport.shm_ring import ShmRingBuffer, native_available
@@ -1598,8 +1599,18 @@ def _bench_e2e_streaming(jax, calib, pool, batch_size, extras, wd=None):
     def produce(queue, n=n_frames):
         for i in range(n):
             rec = FrameRecord(0, i, pool16[i % len(pool16)], 9.5)
+            # hop stamps ride the in-process ring by reference, so the e2e
+            # run below decomposes into named stages (obs.stages); over shm
+            # the encode drops them (observability never goes on the wire).
+            # enq is stamped BEFORE each put attempt (re-stamped on retry),
+            # matching producer._Sender: the consumer thread can pop the
+            # record the instant put returns, and a late enq stamp would
+            # make queue_dwell = deq - enq negative
+            mark_hop(rec, HOP_SRC)
+            mark_hop(rec, HOP_ENQ)
             while not queue.put(rec):
                 time.sleep(0.0005)
+                mark_hop(rec, HOP_ENQ)
         # not inside assert: python -O must not strip the EOS delivery
         if not queue.put_wait(EndOfStream(total_events=n), timeout=300.0):
             raise RuntimeError("EOS delivery timed out")
@@ -1673,6 +1684,38 @@ def _bench_e2e_streaming(jax, calib, pool, batch_size, extras, wd=None):
     extras["env_bound_e2e_p50_frame_ms"] = round(lat["p50_ms"] / batch_size, 3)
     extras["env_bound_e2e_p50_batch_ms"] = round(lat["p50_ms"], 2)
     extras["env_bound_e2e_p99_batch_ms"] = round(lat["p99_ms"], 2)
+    # stage-level decomposition into the bench artifact: register the
+    # run's metrics and emit the registry snapshot, so every future
+    # BENCH_* round carries per-stage latency (enqueue, queue_dwell,
+    # dequeue, batch, device_put, dispatch) alongside the headline fps
+    from psana_ray_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry.default()
+    reg.register("bench.e2e", pipe.metrics)
+    stage_pipe = pipe
+    if use_shm:
+        # config 2b: hop stamps are process-local and do not cross the shm
+        # encode, so the timed run above has no stage data here — repeat
+        # the stream over the in-process ring (same geometry, compiled
+        # calib, untimed: only its DECOMPOSITION is recorded)
+        from psana_ray_tpu.transport import RingBuffer
+
+        q3 = RingBuffer(maxsize=24)
+        t_prod = threading.Thread(target=produce, args=(q3,), daemon=True)
+        stage_pipe = InfeedPipeline(
+            q3, batch_size=batch_size, prefetch_depth=2, poll_interval_s=0.001
+        )
+        t_prod.start()
+        stage_pipe.run(lambda b: calib(b.frames), block_until_ready=True)
+        t_prod.join()
+        reg.register("bench.e2e_stages", stage_pipe.metrics)
+    extras["obs_registry_snapshot"] = reg.snapshot()
+    stage_means = {
+        name: st.get("mean_ms")
+        for name, st in stage_pipe.metrics.stages.snapshot().items()
+    }
+    if stage_means:
+        log(f"e2e stage decomposition (mean ms/record): {stage_means}")
     log(
         f"e2e [{transport}] step latency: p50={lat['p50_ms']:.1f}ms "
         f"p99={lat['p99_ms']:.1f}ms per {batch_size}-frame batch "

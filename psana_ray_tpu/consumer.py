@@ -45,9 +45,7 @@ class DataReader:
         self._queue = None
 
     # -- lifecycle (parity: data_reader.py:11-29,39-44) -------------------
-    def connect(self) -> "DataReader":
-        if self._queue is not None:
-            return self
+    def _open(self):
         import dataclasses
 
         from psana_ray_tpu.transport.addressing import open_queue
@@ -55,8 +53,13 @@ class DataReader:
         cfg = dataclasses.replace(
             self.config, queue_name=self.queue_name, namespace=self.namespace
         )
+        return open_queue(cfg, role="consumer", address=self.address)
+
+    def connect(self) -> "DataReader":
+        if self._queue is not None:
+            return self
         try:
-            self._queue = open_queue(cfg, role="consumer", address=self.address)
+            self._queue = self._open()
         except RendezvousTimeout as e:
             raise DataReaderError(f"could not find queue {self.queue_name!r}: {e}") from e
         return self
@@ -143,6 +146,17 @@ class DataReader:
         except TransportClosed as e:
             raise DataReaderError(str(e)) from e
 
+    def open_monitor(self):
+        """Open an INDEPENDENT queue handle for metrics polling.
+
+        Never hand the data connection to a monitoring thread: over TCP
+        the server treats the next opcode on a connection as the implicit
+        ACK of that connection's in-flight deliveries (transport.tcp), so
+        a ``size()`` probe from a heartbeat thread would confirm frames
+        the main thread is still processing and forfeit crash-redelivery.
+        A separate connection never GETs, so it has nothing to ACK."""
+        return self._open()
+
     def _check_connected(self):
         if self._queue is None:
             raise DataReaderError("not connected — call connect() or use as context manager")
@@ -154,6 +168,7 @@ def main(argv=None):
     import argparse
     import logging
     import signal
+    import threading
 
     from psana_ray_tpu.utils.hostmem import enable_large_alloc_reuse
 
@@ -171,6 +186,16 @@ def main(argv=None):
         help="capture a jax.profiler trace of the consume loop into this "
         "directory (view in TensorBoard's Profile tab)",
     )
+    p.add_argument(
+        "--status_interval", type=float, default=0.0,
+        help="log a metrics heartbeat (PipelineMetrics.status_line: "
+        "frames/s, Gbit/s, latency quantiles, queue depth) every N "
+        "seconds — the consumer-side mirror of the producer's end-of-run "
+        "summary; 0 = off",
+    )
+    from psana_ray_tpu.obs import add_metrics_args
+
+    add_metrics_args(p)
     p.add_argument(
         "--cursor_path", default=None,
         help="persist a StreamCursor (contiguous per-shard watermark of "
@@ -230,13 +255,55 @@ def main(argv=None):
             )
             return 1
 
+    # Observability: per-frame counters always (they also feed the final
+    # "end of stream" line); the heartbeat thread and the HTTP endpoint
+    # only exist when their flags ask for them (zero cost disabled).
+    # Started AFTER every early-return validation above, so a refused run
+    # never leaks the bound port or the heartbeat thread.
+    import time as _time
+
+    from psana_ray_tpu.obs import MetricsRegistry, start_metrics_server
+    from psana_ray_tpu.obs.stages import STAGE_QUEUE_DWELL
+    from psana_ray_tpu.utils.metrics import PipelineMetrics
+
+    metrics = PipelineMetrics()
+    observe_dwell = a.status_interval > 0 or a.metrics_port > 0
+    MetricsRegistry.default().register("consumer", metrics)
+    metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    heartbeat_done = threading.Event()
+    heartbeat = None
+    if a.status_interval > 0:
+        def _heartbeat():
+            while not heartbeat_done.wait(a.status_interval):
+                log.info("consumer %d status: %s", a.consumer_id, metrics.status_line())
+
+        heartbeat = threading.Thread(target=_heartbeat, daemon=True, name="consumer-heartbeat")
+        heartbeat.start()
+
+    monitor = None
     try:
         with trace(a.profile_dir), DataReader(
             address=a.address, queue_name=a.queue_name, namespace=a.namespace
         ) as reader:
+            if observe_dwell:
+                # depth in the heartbeat — over a DEDICATED handle, never
+                # the data connection (see DataReader.open_monitor: a
+                # size() probe there would ACK in-flight deliveries)
+                try:
+                    monitor = reader.open_monitor()
+                    metrics.attach_queue(monitor)
+                except Exception as e:  # noqa: BLE001 — depth is optional
+                    log.debug("queue monitor unavailable: %s", e)
             try:
                 for rec in reader.iter_records(stop=_should_stop):
                     n += 1
+                    metrics.observe_frame(rec.nbytes)
+                    if observe_dwell and rec.timestamp:
+                        # wall-clock dwell (producer stamp -> this read):
+                        # exact same-host, approximate cross-host (NTP)
+                        metrics.stages.observe(
+                            STAGE_QUEUE_DWELL, max(0.0, _time.time() - rec.timestamp)
+                        )
                     if not a.quiet:
                         log.info(
                             "consumer %d: rank=%d idx=%d shape=%s energy=%.2f",
@@ -254,13 +321,28 @@ def main(argv=None):
             finally:
                 if cursor is not None:
                     cursor.save(a.cursor_path)
-        log.info("consumer %d: end of stream after %d frames", a.consumer_id, n)
+        log.info(
+            "consumer %d: end of stream after %d frames (%s)",
+            a.consumer_id, n, metrics.status_line(),
+        )
     except DataReaderError as e:  # parity: psana_consumer.py:41-44
         log.error("consumer %d: queue is dead (%s); exiting", a.consumer_id, e)
         return 1
     except ValueError as e:  # cursor stride/shard misconfiguration
         log.error("consumer %d: %s", a.consumer_id, e)
         return 1
+    finally:
+        heartbeat_done.set()
+        if heartbeat is not None:
+            heartbeat.join(timeout=1.0)
+        metrics.attach_queue(None)  # monitor handle is about to die
+        if monitor is not None and hasattr(monitor, "disconnect"):
+            try:
+                monitor.disconnect()
+            except Exception:  # noqa: BLE001 — already closing
+                pass
+        if metrics_server is not None:
+            metrics_server.close()
     return 0
 
 

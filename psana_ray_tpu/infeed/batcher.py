@@ -17,6 +17,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from psana_ray_tpu.obs.stages import HOP_BATCH, HOP_DEQ, HOP_PUSH
 from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord
 from psana_ray_tpu.transport.recovery import return_to_queue
 from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
@@ -46,6 +47,11 @@ class Batch:
     event_idx: np.ndarray  # [B] int64
     photon_energy: np.ndarray  # [B] float32
     num_valid: int = -1
+    # Host-only observability metadata: one hop-stamp dict per TIMED real
+    # record (psana_ray_tpu.records.mark_hop), None for untimed streams.
+    # Deliberately NOT part of map_arrays — device placement and global
+    # assembly must never touch it (dataclasses.replace carries it along).
+    hops: Optional[List[dict]] = None
 
     def __post_init__(self):
         if self.num_valid < 0:
@@ -116,6 +122,7 @@ class FrameBatcher:
         self._pool_i = 0
         self._cur: Optional[tuple] = None
         self._fill = 0
+        self._hops: Optional[List[dict]] = None  # stamps of the current batch
 
     def _alloc(self) -> tuple:
         b = self.batch_size
@@ -155,6 +162,12 @@ class FrameBatcher:
         rank[i] = rec.shard_rank
         idx[i] = rec.event_idx
         energy[i] = rec.photon_energy
+        hops = rec.hops
+        if hops is not None:  # timed stream: stamp copy-into-batch done
+            hops[HOP_PUSH] = time.monotonic()
+            if self._hops is None:
+                self._hops = []
+            self._hops.append(hops)
         self._fill += 1
         if self._fill == self.batch_size:
             return self._emit()
@@ -181,7 +194,12 @@ class FrameBatcher:
             energy[n:] = 0
         self._cur = None
         self._fill = 0
-        return Batch(frames, valid, rank, idx, energy, num_valid=n)
+        hops, self._hops = self._hops, None
+        if hops is not None:  # one emit stamp for every record in the batch
+            t = time.monotonic()
+            for h in hops:
+                h[HOP_BATCH] = t
+        return Batch(frames, valid, rank, idx, energy, num_valid=n, hops=hops)
 
 
 def batches_from_queue(
@@ -250,6 +268,7 @@ def batches_from_queue(
                     return
                 continue
             starved_since = None
+            t_deq = time.monotonic()
             tally.flush_duplicates(queue)  # gets just freed slots
             for pos, item in enumerate(items):
                 if isinstance(item, EndOfStream):
@@ -272,6 +291,8 @@ def batches_from_queue(
                     continue
                 if batcher is None:
                     batcher = FrameBatcher(batch_size, n_buffers=n_buffers)
+                if item.hops is not None:  # timed stream: stamp the pop
+                    item.hops[HOP_DEQ] = t_deq
                 out = batcher.push(item)
                 if out is not None:
                     yield out

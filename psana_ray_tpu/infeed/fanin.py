@@ -101,6 +101,9 @@ class FanInPipeline:
                     max_wait_s=s.max_wait_s,
                     place_on_device=s.place_on_device,
                     batcher_buffers=s.batcher_buffers,
+                    # per-detector series on the process metrics endpoint
+                    # (infeed.<detector>; unregistered when the leg closes)
+                    name=s.name,
                 )
         except BaseException:
             # a later leg failed to build; already-started legs are live

@@ -27,6 +27,7 @@ Three layers:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -34,7 +35,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from psana_ray_tpu.infeed.batcher import Batch, batches_from_queue
+from psana_ray_tpu.obs.stages import HOP_DEVICE_PUT
 from psana_ray_tpu.utils.metrics import PipelineMetrics
+
+try:  # Python 3.11+ builtin
+    ExceptionGroup = ExceptionGroup  # noqa: PLW0127 — probe the builtin
+except NameError:  # pragma: no cover — 3.10 fallback, same .exceptions shape
+
+    class ExceptionGroup(Exception):  # type: ignore[no-redef]
+        """Minimal stand-in: message + ``.exceptions`` list (no split/
+        subgroup machinery — callers here only read ``.exceptions``)."""
+
+        def __init__(self, message, exceptions):
+            super().__init__(f"{message} ({len(exceptions)} sub-exceptions)")
+            self.exceptions = tuple(exceptions)
 
 
 class MultiDetectorGlobalConsumer:
@@ -74,6 +88,14 @@ class MultiDetectorGlobalConsumer:
         if not legs:
             raise ValueError("need at least one detector leg")
         self.legs = dict(legs)
+        # every leg on the process metrics endpoint, named by detector —
+        # legs built with their own obs_name keep it (already registered)
+        from psana_ray_tpu.obs import MetricsRegistry
+
+        for name, leg in self.legs.items():
+            if leg.obs_name is None:
+                leg.obs_name = name
+                MetricsRegistry.default().register(f"multihost.{name}", leg.metrics)
 
     def run(
         self,
@@ -166,9 +188,14 @@ def make_global_Batch(local: Batch, mesh: Mesh, data_axis: str = "data") -> Batc
     ``num_valid`` stays this HOST's real-row count (a host int, no device
     sync) — the global count is ``sum(valid)`` on device when needed
     (:class:`GlobalStreamConsumer` uses exactly that for termination)."""
-    return local.map_arrays(
+    g = local.map_arrays(
         lambda a: make_global_batch(np.asarray(a), mesh, data_axis)
     )
+    if g.hops:  # timed stream: global assembly IS this path's device_put
+        t = time.monotonic()
+        for h in g.hops:
+            h[HOP_DEVICE_PUT] = t
+    return g
 
 
 class GlobalStreamConsumer:
@@ -215,6 +242,7 @@ class GlobalStreamConsumer:
         poll_interval_s: float = 0.01,
         metrics: Optional[PipelineMetrics] = None,
         stall_timeout_s: Optional[float] = None,
+        obs_name: Optional[str] = None,
     ):
         self.queue = queue
         self.local_batch_size = local_batch_size
@@ -226,6 +254,14 @@ class GlobalStreamConsumer:
         self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
         self.stall_timeout_s = stall_timeout_s
         self._pad: Optional[Batch] = None
+        self.obs_name = obs_name or None
+        if self.obs_name:
+            # this host's leg on the process metrics endpoint; a leg is
+            # deployment-lifetime, so no unregister hook is needed — a
+            # replacement under the same name just takes over the series
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().register(f"multihost.{self.obs_name}", self.metrics)
 
     def _padding_batch(self) -> Batch:
         # cached: a drained host may spin many identical all-padding

@@ -19,8 +19,14 @@ import jax
 import numpy as np
 
 from psana_ray_tpu.infeed.batcher import Batch, batches_from_queue
+from psana_ray_tpu.obs.stages import (
+    HOP_DEVICE_PUT,
+    STAGE_DEVICE_PUT,
+    STAGE_DISPATCH,
+    observe_batch_stages,
+)
 from psana_ray_tpu.utils.metrics import PipelineMetrics
-from psana_ray_tpu.utils.trace import annotate
+from psana_ray_tpu.utils.trace import annotate_stage
 
 
 class StopStream(Exception):
@@ -66,8 +72,15 @@ class DevicePrefetcher:
         self._thread.start()
 
     def _default_to_device(self, batch: Batch):
-        with annotate("infeed.device_put"):
-            return self._place(batch)
+        # annotate_stage: same stage vocabulary on the device timeline as
+        # on the metrics endpoint (obs.stages)
+        with annotate_stage(STAGE_DEVICE_PUT):
+            out = self._place(batch)
+        if batch.hops:  # timed stream: stamp device staging done
+            t = time.monotonic()
+            for h in batch.hops:
+                h[HOP_DEVICE_PUT] = t
+        return out
 
     def _place(self, batch: Batch):
         # num_valid stays the host int — counting on-device would sync
@@ -148,15 +161,18 @@ def drive_step(
     bytes; the global sharded array's nbytes would overcount by the
     process count)."""
     t0 = time.monotonic()
-    with annotate("pipeline.step"):
+    with annotate_stage(STAGE_DISPATCH):
         out = step(batch)
         if block_until_ready:
             out = jax.block_until_ready(out)
+    t1 = time.monotonic()
     metrics.observe_batch(
         batch.num_valid,
-        time.monotonic() - t0,
+        t1 - t0,
         nbytes=int(getattr(batch.frames, "nbytes", 0)) if nbytes is None else nbytes,
     )
+    if batch.hops:  # timed stream: fold hop stamps into stage histograms
+        observe_batch_stages(metrics.stages, batch, t1)
     return out
 
 
@@ -178,10 +194,16 @@ class InfeedPipeline:
         metrics: Optional[PipelineMetrics] = None,
         place_on_device: bool = True,
         batcher_buffers: int = 0,
+        name: Optional[str] = None,
     ):
         """``place_on_device=False`` keeps batches as host numpy arrays —
         for host-pipeline measurement or host-only consumers, where the
-        device_put would be a pure extra frame-sized memcpy."""
+        device_put would be a pure extra frame-sized memcpy.
+
+        ``name`` (optional) registers this pipeline's metrics as
+        ``infeed.<name>`` in the process :class:`~psana_ray_tpu.obs.
+        MetricsRegistry` (unregistered on :meth:`close`), so a
+        ``--metrics_port`` endpoint in the same process exposes it."""
         if batcher_buffers > 0 and batcher_buffers < prefetch_depth + 3:
             # alive at once: prefetch_depth queued + 1 with the consumer
             # + 1 being filled + 1 margin for an async/aliasing device_put
@@ -193,6 +215,11 @@ class InfeedPipeline:
         self.queue = queue
         self.batch_size = batch_size
         self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
+        self._obs_name = f"infeed.{name}" if name else None
+        if self._obs_name:
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().register(self._obs_name, self.metrics)
         stop = threading.Event()
         self._batches = batches_from_queue(
             queue,
@@ -215,6 +242,11 @@ class InfeedPipeline:
 
     def close(self):
         self._prefetcher.close()
+        if self._obs_name:
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().unregister(self._obs_name)
+            self._obs_name = None
 
     def __enter__(self):
         return self

@@ -1,0 +1,44 @@
+"""Cluster-wide observability: metrics export, stage timing, stall detection.
+
+The three legs (ISSUE 1 / SURVEY.md §5 — the reference has no
+observability story at all):
+
+- **Export** — :class:`MetricsRegistry` aggregates every process-local
+  metrics object and serves Prometheus text format over a stdlib HTTP
+  thread (:class:`MetricsServer`, ``--metrics_port`` on every CLI);
+- **Stage timing** — :mod:`psana_ray_tpu.obs.stages` names the pipeline
+  boundaries; monotonic hop stamps threaded through the record envelope
+  decompose end-to-end latency into per-stage histograms;
+- **Health** — :class:`StallDetector` turns queue counters into
+  structured backpressure / stall / liveness warnings, and the queue
+  server answers a stats RPC (``transport.tcp`` opcode ``T``).
+
+Everything here is pure stdlib and importable without JAX.
+"""
+
+from psana_ray_tpu.obs.exporter import (  # noqa: F401
+    MetricsServer,
+    add_metrics_args,
+    start_metrics_server,
+)
+from psana_ray_tpu.obs.registry import MetricsRegistry, snapshot_source  # noqa: F401
+from psana_ray_tpu.obs.stages import (  # noqa: F401
+    STAGE_BATCH,
+    STAGE_DEQUEUE,
+    STAGE_DEVICE_PUT,
+    STAGE_DISPATCH,
+    STAGE_E2E,
+    STAGE_ENQUEUE,
+    STAGE_QUEUE_DWELL,
+    STAGES,
+    StageTimes,
+    observe_batch_stages,
+    observe_record_stages,
+)
+from psana_ray_tpu.obs.stall import (  # noqa: F401
+    EVENT_BACKPRESSURE,
+    EVENT_CONSUMER_STALL,
+    EVENT_PRODUCER_IDLE,
+    StallDetector,
+    StallEvent,
+)

@@ -1,0 +1,109 @@
+"""Canonical pipeline stage names + per-record latency decomposition.
+
+Every frame crosses the same boundaries on its way from detector source to
+device step; this module names them ONCE so the record envelope
+(:func:`psana_ray_tpu.records.mark_hop`), the latency histograms
+(:class:`psana_ray_tpu.utils.metrics.StageTimes`), the Prometheus export,
+and the device-timeline annotations (:func:`psana_ray_tpu.utils.trace.
+annotate_stage`) all agree.
+
+Hop boundaries (monotonic timestamps stamped on the record)::
+
+    src ──enqueue──▶ enq ──queue_dwell──▶ deq ──dequeue──▶ push
+        ──batch──▶ batch ──device_put──▶ device_put ──dispatch──▶ (step done)
+
+Stage semantics:
+
+- ``enqueue``      source read done → accepted by the transport
+  (includes producer-side backpressure wait);
+- ``queue_dwell``  accepted → popped by a consumer (queue residency);
+- ``dequeue``      popped → copied into the batch buffer (decode + memcpy);
+- ``batch``        in the batch buffer → batch emitted (waiting for the
+  batch to fill; first records of a batch wait longest);
+- ``device_put``   batch emitted → staged on device (host→device copy,
+  or global sharded assembly on multi-host);
+- ``dispatch``     staged → step returned (prefetch-buffer dwell + device
+  step; with ``block_until_ready`` a true device latency).
+
+Because stages are CONSECUTIVE differences of one record's timeline, the
+per-stage means over a set of records sum EXACTLY to the mean of the
+``e2e`` pseudo-stage (src → step done) over the same records — that is
+what lets BENCH's 3400× device-vs-e2e gap decompose into named stages
+instead of a single opaque number. A missing boundary (e.g. records that
+crossed a process hop, where monotonic stamps don't travel) never breaks
+the telescoping: the next present boundary's stage absorbs the gap.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from psana_ray_tpu.utils.metrics import StageTimes  # noqa: F401  (re-export)
+
+# Hop (boundary) names, in pipeline order.
+HOP_SRC = "src"
+HOP_ENQ = "enq"
+HOP_DEQ = "deq"
+HOP_PUSH = "push"
+HOP_BATCH = "batch"
+HOP_DEVICE_PUT = "device_put"
+# the final boundary (step done) is passed explicitly, never stamped
+
+HOPS = (HOP_SRC, HOP_ENQ, HOP_DEQ, HOP_PUSH, HOP_BATCH, HOP_DEVICE_PUT)
+
+# Stage names: STAGES[i] spans HOPS[i] -> HOPS[i+1]; the last stage spans
+# the last hop -> step completion.
+STAGE_ENQUEUE = "enqueue"
+STAGE_QUEUE_DWELL = "queue_dwell"
+STAGE_DEQUEUE = "dequeue"
+STAGE_BATCH = "batch"
+STAGE_DEVICE_PUT = "device_put"
+STAGE_DISPATCH = "dispatch"
+STAGE_E2E = "e2e"  # pseudo-stage: src -> step done (the decomposed total)
+
+STAGES = (
+    STAGE_ENQUEUE,
+    STAGE_QUEUE_DWELL,
+    STAGE_DEQUEUE,
+    STAGE_BATCH,
+    STAGE_DEVICE_PUT,
+    STAGE_DISPATCH,
+)
+
+
+def observe_record_stages(
+    stages: StageTimes, hops: dict, t_end: float
+) -> None:
+    """Fold one record's hop stamps + the step-completion time into the
+    per-stage histograms. Missing boundaries are skipped; the stage ending
+    at the next present boundary absorbs the gap, so the observed stages
+    always telescope to (last boundary - first boundary)."""
+    prev: Optional[float] = None
+    for i, hop in enumerate(HOPS):
+        t = hops.get(hop)
+        if t is None:
+            continue
+        if prev is not None:
+            # STAGES[i-1] is the stage ENDING at this boundary; when an
+            # earlier boundary was missing it absorbs the gap (telescoping)
+            stages.observe(STAGES[i - 1], t - prev)
+        prev = t
+    if prev is not None:
+        stages.observe(STAGE_DISPATCH, t_end - prev)
+        t0 = hops.get(HOP_SRC)
+        if t0 is not None:
+            stages.observe(STAGE_E2E, t_end - t0)
+
+
+def observe_batch_stages(stages: StageTimes, batch, t_end: Optional[float] = None) -> None:
+    """Per-record stage decomposition for a whole batch (its ``hops``
+    list carries one stamp dict per timed real record). Near-zero cost on
+    untimed streams: ``batch.hops`` is None unless a producer stamped the
+    records."""
+    hops_list = getattr(batch, "hops", None)
+    if not hops_list:
+        return
+    t_end = time.monotonic() if t_end is None else t_end
+    for hops in hops_list:
+        observe_record_stages(stages, hops, t_end)

@@ -1,0 +1,249 @@
+"""Stall / backpressure detection over transport queues.
+
+The reference's failure mode for a slow or dead consumer is SILENT: the
+queue fills, producers spin in backoff, and nothing anywhere says why
+(SURVEY.md §5 — "debugging a slow consumer means print statements"). The
+:class:`StallDetector` polls queue ``stats()`` and emits STRUCTURED warn
+events when the pipeline degenerates:
+
+- ``backpressure``    the queue has sat at maxsize for longer than
+  ``full_threshold_s`` — consumers are not keeping up (or died);
+- ``consumer_stall``  depth > 0 but the get counter has not moved for
+  ``idle_threshold_s`` — data is waiting and nobody reads;
+- ``producer_idle``   depth == 0 and the put counter has not moved for
+  ``idle_threshold_s`` — consumers are starved and nobody feeds them
+  (producer liveness; a clean EOS also looks like this, which is why
+  these are warnings with context, not fatal errors).
+
+Each event is logged once per episode (the flag re-arms when the
+condition clears), handed to ``on_event``, kept in a bounded ``events``
+deque, and counted — the detector is itself a registry source, so
+``psana_ray_stalls_*_total`` series appear on the metrics endpoint.
+
+``poll_once(now=...)`` is separated from the thread loop so tests drive
+time explicitly instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from psana_ray_tpu.utils.metrics import probe_queue_stats as _queue_stats
+
+logger = logging.getLogger(__name__)
+
+EVENT_BACKPRESSURE = "backpressure"
+EVENT_CONSUMER_STALL = "consumer_stall"
+EVENT_PRODUCER_IDLE = "producer_idle"
+
+
+@dataclasses.dataclass(frozen=True)
+class StallEvent:
+    kind: str
+    queue: str
+    duration_s: float
+    depth: int
+    maxsize: int
+    detail: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+class _QueueState:
+    __slots__ = (
+        "last_puts", "last_gets", "last_t",
+        "full_since", "full_warned",
+        "idle_since", "idle_warned",
+        "starved_since", "starved_warned",
+        "put_rate", "get_rate",
+    )
+
+    def __init__(self):
+        self.last_puts: Optional[int] = None
+        self.last_gets: Optional[int] = None
+        self.last_t: Optional[float] = None
+        self.full_since: Optional[float] = None
+        self.full_warned = False
+        self.idle_since: Optional[float] = None
+        self.idle_warned = False
+        self.starved_since: Optional[float] = None
+        self.starved_warned = False
+        self.put_rate = 0.0
+        self.get_rate = 0.0
+
+
+class StallDetector:
+    """Poll watched queues; warn loudly when the stream degenerates."""
+
+    def __init__(
+        self,
+        poll_interval_s: float = 1.0,
+        full_threshold_s: float = 5.0,
+        idle_threshold_s: float = 10.0,
+        on_event: Optional[Callable[[StallEvent], None]] = None,
+        max_events: int = 256,
+    ):
+        self.poll_interval_s = poll_interval_s
+        self.full_threshold_s = full_threshold_s
+        self.idle_threshold_s = idle_threshold_s
+        self.on_event = on_event
+        self.events: deque = deque(maxlen=max_events)
+        self._counts: Dict[str, int] = {
+            EVENT_BACKPRESSURE: 0,
+            EVENT_CONSUMER_STALL: 0,
+            EVENT_PRODUCER_IDLE: 0,
+        }
+        self._lock = threading.Lock()
+        self._watched: Dict[str, Any] = {}
+        self._provider: Optional[Callable[[], Dict[str, Any]]] = None
+        self._states: Dict[str, _QueueState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring -----------------------------------------------------------
+    def watch(self, name: str, queue) -> "StallDetector":
+        """Watch one queue (anything with ``stats()`` or ``size()``)."""
+        with self._lock:
+            self._watched[name] = queue
+        return self
+
+    def watch_provider(self, provider: Callable[[], Dict[str, Any]]) -> "StallDetector":
+        """Watch a DYNAMIC queue population: ``provider()`` returns
+        ``{name: queue}`` each poll (the queue server's named queues
+        appear as clients OPEN them)."""
+        self._provider = provider
+        return self
+
+    def start(self) -> "StallDetector":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True, name="stall-detector")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "StallDetector":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the watchdog must outlive faults
+                logger.exception("stall detector poll failed")
+
+    # -- detection --------------------------------------------------------
+    def _queues(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._watched)
+        if self._provider is not None:
+            try:
+                out.update(self._provider() or {})
+            except Exception:  # noqa: BLE001
+                logger.exception("stall detector queue provider failed")
+        return out
+
+    def poll_once(self, now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        for name, queue in self._queues().items():
+            try:
+                stats = _queue_stats(queue)
+            except Exception:  # dead transport: closure is its own signal
+                continue
+            self._check_queue(name, stats, now)
+
+    def _check_queue(self, name: str, stats: dict, now: float):
+        with self._lock:  # scrapes iterate _states from the HTTP thread
+            st = self._states.setdefault(name, _QueueState())
+        depth = int(stats.get("depth", 0))
+        maxsize = int(stats.get("maxsize", 0) or 0)
+        puts = stats.get("puts")
+        gets = stats.get("gets")
+
+        if st.last_t is not None and now > st.last_t:
+            dt = now - st.last_t
+            if puts is not None and st.last_puts is not None:
+                st.put_rate = (puts - st.last_puts) / dt
+            if gets is not None and st.last_gets is not None:
+                st.get_rate = (gets - st.last_gets) / dt
+
+        # backpressure: pegged at maxsize
+        if maxsize and depth >= maxsize:
+            st.full_since = now if st.full_since is None else st.full_since
+            if not st.full_warned and now - st.full_since >= self.full_threshold_s:
+                st.full_warned = True
+                self._emit(StallEvent(
+                    EVENT_BACKPRESSURE, name, now - st.full_since, depth, maxsize,
+                    "queue pegged at maxsize; consumers not keeping up",
+                ))
+        else:
+            st.full_since, st.full_warned = None, False
+
+        # consumer stall: data waiting, gets frozen. Requires a real get
+        # counter — a depth-only source (stats() fallback to size()) keeps
+        # a standing depth under healthy steady-state consumption, and
+        # warning on it would cry wolf every idle_threshold_s
+        gets_frozen = gets is not None and gets == st.last_gets
+        if depth > 0 and gets_frozen:
+            st.idle_since = now if st.idle_since is None else st.idle_since
+            if not st.idle_warned and now - st.idle_since >= self.idle_threshold_s:
+                st.idle_warned = True
+                self._emit(StallEvent(
+                    EVENT_CONSUMER_STALL, name, now - st.idle_since, depth, maxsize,
+                    "items queued but no consumer progress",
+                ))
+        else:
+            st.idle_since, st.idle_warned = None, False
+
+        # producer liveness: consumers starved, puts frozen
+        puts_frozen = puts is not None and puts == st.last_puts
+        if depth == 0 and puts_frozen:
+            st.starved_since = now if st.starved_since is None else st.starved_since
+            if not st.starved_warned and now - st.starved_since >= self.idle_threshold_s:
+                st.starved_warned = True
+                self._emit(StallEvent(
+                    EVENT_PRODUCER_IDLE, name, now - st.starved_since, depth, maxsize,
+                    "queue empty and no producer progress (stalled, or done without EOS)",
+                ))
+        else:
+            st.starved_since, st.starved_warned = None, False
+
+        st.last_puts, st.last_gets, st.last_t = puts, gets, now
+
+    def _emit(self, event: StallEvent):
+        with self._lock:
+            self._counts[event.kind] = self._counts.get(event.kind, 0) + 1
+        self.events.append(event)
+        logger.warning("STALL %s", event.to_json())
+        if self.on_event is not None:
+            try:
+                self.on_event(event)
+            except Exception:  # noqa: BLE001
+                logger.exception("stall on_event callback failed")
+
+    # -- registry source ---------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+            states = list(self._states.items())
+        out: dict = {f"{k}_total": v for k, v in counts.items()}
+        for name, st in states:
+            out[name] = {
+                "put_rate": round(st.put_rate, 3),
+                "get_rate": round(st.get_rate, 3),
+            }
+        return out

@@ -27,7 +27,8 @@ from typing import List, Optional
 import numpy as np
 
 from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, SourceConfig, TransportConfig
-from psana_ray_tpu.records import EndOfStream, FrameRecord
+from psana_ray_tpu.obs.stages import HOP_ENQ, HOP_SRC, STAGE_ENQUEUE
+from psana_ray_tpu.records import EndOfStream, FrameRecord, mark_hop
 from psana_ray_tpu.sources import open_source
 from psana_ray_tpu.transport import BackoffPolicy, Registry, TransportClosed, TransportWedged
 from psana_ray_tpu.transport.addressing import open_queue
@@ -65,6 +66,17 @@ class _Sender:
         while self.pending:
             if self.stop.is_set():
                 return False
+            # enqueue hop stamp goes on BEFORE the put so an in-process
+            # consumer can never pop a record that lacks it (it re-stamps
+            # on each backpressure retry, so the final value is just-
+            # before-the-successful-put); producer-side enqueue latency
+            # (source read done -> accepted, incl. backpressure wait)
+            # lands in this process's stage histogram below
+            t_try = time.monotonic()
+            attempt = self.pending if self.batch_size > 1 else self.pending[:1]
+            for r in attempt:
+                if r.hops is not None:
+                    r.hops[HOP_ENQ] = t_try
             try:
                 if self.batch_size > 1:
                     accepted = self.queue.put_batch(self.pending)
@@ -77,6 +89,9 @@ class _Sender:
             if accepted:
                 for r in self.pending[:accepted]:
                     self.metrics.observe_frame(r.nbytes)
+                    h = r.hops
+                    if h is not None and HOP_SRC in h:
+                        self.metrics.stages.observe(STAGE_ENQUEUE, t_try - h[HOP_SRC])
                 del self.pending[:accepted]
                 self.backoff.reset()
             else:
@@ -94,12 +109,20 @@ class ProducerRuntime:
         num_local_shards: int = 1,
         shard_rank_offset: int = 0,
         total_shards: Optional[int] = None,
+        stage_timing: bool = False,
     ):
+        """``stage_timing`` stamps hop timestamps on every record
+        (records.mark_hop) feeding the enqueue-stage histogram and — over
+        in-process transports — downstream stage decomposition. Off by
+        default: the per-frame dict + monotonic stamps are only worth
+        paying when something exports them (the CLI enables it with
+        ``--metrics_port``)."""
         self.config = config
         self.registry = registry or Registry.default()
         self.num_local_shards = num_local_shards
         self.shard_rank_offset = shard_rank_offset
         self.total_shards = total_shards or num_local_shards
+        self.stage_timing = stage_timing
         self.metrics = PipelineMetrics()
         self._queue = None
         self._barrier = threading.Barrier(num_local_shards)
@@ -111,6 +134,12 @@ class ProducerRuntime:
     def bootstrap(self):
         t = self.config.transport
         self._queue = open_queue(t, role="producer", registry=self.registry)
+        if not self.metrics.has_queue:
+            # depth in status/snapshot — unless the CLI already attached a
+            # dedicated monitor handle (over TCP a scrape on the DATA
+            # connection would block behind a put's reconnect backoff,
+            # serialized under the client lock)
+            self.metrics.attach_queue(self._queue)
         logger.info(
             "queue %r ready (namespace=%r address=%r size=%d)",
             t.queue_name, t.namespace, t.address, t.queue_size,
@@ -152,6 +181,8 @@ class ProducerRuntime:
                 if mask is not None:
                     data = np.where(mask, data, 0)  # parity: producer.py:92-95
                 rec = FrameRecord(rank, int(idx), data, energy, timestamp=time.time())
+                if self.stage_timing:
+                    mark_hop(rec, HOP_SRC)  # source read done
                 if not sender.send(rec):
                     logger.warning("rank %d: queue dead, exiting", rank)
                     return  # parity: producer.py:112-114
@@ -274,6 +305,9 @@ def parse_arguments(argv=None):
     p.add_argument("--num_consumers", type=int, default=1)
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--log_level", default="INFO")
+    from psana_ray_tpu.obs import add_metrics_args
+
+    add_metrics_args(p)
     p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
     p.add_argument("--num_events", type=int, default=1024, help="synthetic events")
     p.add_argument(
@@ -370,6 +404,7 @@ def main(argv=None):
         num_local_shards=args.num_shards,
         shard_rank_offset=offset,
         total_shards=total,
+        stage_timing=args.metrics_port > 0,
     )
 
     def _sigint(signum, frame):  # parity: producer.py:73-76,142-143
@@ -377,7 +412,34 @@ def main(argv=None):
         runtime.stop()
 
     signal.signal(signal.SIGINT, _sigint)
-    runtime.run(block=True)
+    from psana_ray_tpu.obs import MetricsRegistry, start_metrics_server
+
+    MetricsRegistry.default().register("producer", runtime.metrics)
+    metrics_server = start_metrics_server(args.metrics_port, host=args.metrics_host)
+    monitor = None
+    if metrics_server is not None and str(config.transport.address).startswith("tcp://"):
+        # depth for scrapes over a DEDICATED connection: on the data
+        # connection a stats() probe would queue behind a put's reconnect
+        # backoff under the client lock, hanging /metrics for the whole
+        # outage (in-process/shm handles have no such serialization and
+        # bootstrap attaches them directly)
+        try:
+            monitor = open_queue(
+                config.transport, role="consumer", address=config.transport.address
+            )
+            runtime.metrics.attach_queue(monitor)
+        except Exception as e:  # noqa: BLE001 — depth is optional
+            logger.debug("queue monitor unavailable: %s", e)
+    try:
+        runtime.run(block=True)
+    finally:
+        if metrics_server is not None:
+            metrics_server.close()
+        if monitor is not None and hasattr(monitor, "disconnect"):
+            try:
+                monitor.disconnect()
+            except Exception:  # noqa: BLE001 — already closing
+                pass
     logger.info("producer done: %s", runtime.metrics.status_line())
 
 

@@ -55,6 +55,24 @@ def main(argv=None):
             "empty or this many seconds pass, THEN closes (0 = abrupt)"
         ),
     )
+    from psana_ray_tpu.obs import add_metrics_args
+
+    add_metrics_args(p)
+    p.add_argument(
+        "--stall_poll_s", type=float, default=1.0,
+        help="queue-health poll interval for the stall detector "
+        "(backpressure / consumer-stall / producer-idle warnings); "
+        "0 = detector off",
+    )
+    p.add_argument(
+        "--stall_full_s", type=float, default=5.0,
+        help="warn 'backpressure' after a queue sits at maxsize this long",
+    )
+    p.add_argument(
+        "--stall_idle_s", type=float, default=10.0,
+        help="warn 'consumer_stall'/'producer_idle' after put/get "
+        "counters freeze this long",
+    )
     p.add_argument("--log_level", default="INFO")
     a = p.parse_args(argv)
     logging.basicConfig(
@@ -62,6 +80,7 @@ def main(argv=None):
         format="%(asctime)s - %(levelname)s - %(message)s",
     )
 
+    from psana_ray_tpu.obs import MetricsRegistry, StallDetector, start_metrics_server
     from psana_ray_tpu.transport.ring import RingBuffer
     from psana_ray_tpu.transport.tcp import TcpQueueServer
 
@@ -99,6 +118,22 @@ def main(argv=None):
         a.host, server.port, a.queue_size, server.port,
     )
 
+    # Observability: every queue (default + OPENed named ones) as a
+    # registry source, the Prometheus endpoint over it, and the stall
+    # detector watching the same dynamic population. All three are
+    # zero-cost when their flags are off.
+    MetricsRegistry.default().register("queue_server", server.stats_all)
+    metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    stall = None
+    if a.stall_poll_s > 0:
+        stall = StallDetector(
+            poll_interval_s=a.stall_poll_s,
+            full_threshold_s=a.stall_full_s,
+            idle_threshold_s=a.stall_idle_s,
+        ).watch_provider(server.queues_by_name)
+        MetricsRegistry.default().register("stalls", stall)
+        stall.start()
+
     done = threading.Event()
     force = threading.Event()
 
@@ -129,6 +164,10 @@ def main(argv=None):
             logger.warning(
                 "drain window ended with %d item(s) still queued", server.depth()
             )
+    if stall is not None:
+        stall.stop()
+    if metrics_server is not None:
+        metrics_server.close()
     server.close_all()  # unblock ALL clients with TransportClosed (dead-queue parity)
     server.shutdown()
     return 0

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import dataclasses
 import struct
+import time
+from typing import Optional
 
 import numpy as np
 
@@ -62,6 +64,13 @@ class FrameRecord:
     photon_energy: float
     timestamp: float = 0.0
     schema_version: int = SCHEMA_VERSION
+    # Process-local monotonic hop timestamps (observability, never on the
+    # wire): ``{hop_name: time.monotonic()}`` written by :func:`mark_hop`
+    # at each pipeline boundary (psana_ray_tpu.obs.stages names the hops).
+    # None (the default, and always after decode) keeps the hot path at
+    # zero cost for streams nobody is timing. Cross-process, the wall-clock
+    # ``timestamp`` field is the enqueue-side stamp consumers fall back to.
+    hops: Optional[dict] = dataclasses.field(default=None, repr=False)
 
     def __post_init__(self):
         panels = np.asarray(self.panels)
@@ -124,6 +133,25 @@ class FrameRecord:
             timestamp=ts,
             schema_version=version,
         )
+
+
+def mark_hop(rec, hop: str, t: Optional[float] = None) -> None:
+    """Stamp ``time.monotonic()`` (or ``t``) on ``rec`` under ``hop``.
+
+    The observability layer's envelope hook: producers stamp source-read
+    and enqueue, the batcher stamps dequeue/assembly, the prefetcher
+    stamps device placement, and :func:`psana_ray_tpu.obs.stages.
+    observe_batch_stages` turns consecutive stamps into per-stage latency
+    histograms. No-op on non-frame items (EOS markers are not timed);
+    safe on the frozen dataclass (the dict is attached once via
+    ``object.__setattr__``, then mutated in place)."""
+    if not isinstance(rec, FrameRecord):
+        return
+    hops = rec.hops
+    if hops is None:
+        hops = {}
+        object.__setattr__(rec, "hops", hops)
+    hops[hop] = time.monotonic() if t is None else t
 
 
 @dataclasses.dataclass(frozen=True)

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -196,6 +197,11 @@ class SfxPipeline:
         self._step = jax.jit(self._device_step)
         self.n_events = 0
         self.n_peaks = 0
+        # events/s, bytes/s, per-batch device-wait latency; a registry
+        # source for the --metrics_port endpoint (obs.MetricsRegistry)
+        from psana_ray_tpu.utils.metrics import PipelineMetrics
+
+        self.metrics = PipelineMetrics()
 
     # -- the one compiled program ----------------------------------------
     def _device_step(self, frames):
@@ -244,7 +250,14 @@ class SfxPipeline:
 
         out, batch = pending
         b, p, h, _ = batch.frames.shape
+        t0 = time.monotonic()
         yx, score, n = (np.asarray(a) for a in out)
+        # device-wait latency: with one batch in flight this is the step
+        # time NOT hidden behind the host fold/append of the previous batch
+        self.metrics.observe_batch(
+            int(np.sum(batch.valid)), time.monotonic() - t0,
+            nbytes=int(getattr(batch.frames, "nbytes", 0)),
+        )
         sets = []
         for i in range(b):
             if not batch.valid[i]:
@@ -417,6 +430,9 @@ def main(argv=None):
         help="allow truncating an existing --output on a FRESH run "
         "(resumed runs — cursor already has positions — always append)",
     )
+    from psana_ray_tpu.obs import add_metrics_args
+
+    add_metrics_args(ap)
     ap.add_argument("--log_level", default="INFO")
     a = ap.parse_args(argv)
     logging.basicConfig(
@@ -530,6 +546,24 @@ def main(argv=None):
                 a.output,
             )
             return 1
+    from psana_ray_tpu.obs import MetricsRegistry, start_metrics_server
+
+    metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    # queue depth for scrapes over a DEDICATED handle, never the data
+    # connection: over TCP any opcode on the data connection implicitly
+    # ACKs its in-flight GET deliveries (transport.tcp serve loop), so a
+    # stats() probe from the metrics HTTP thread would confirm frames this
+    # process is still folding and forfeit crash-redelivery
+    monitor = None
+    if metrics_server is not None:
+        from psana_ray_tpu.consumer import DataReader
+
+        try:
+            monitor = DataReader(
+                address=a.address, queue_name=a.queue_name, namespace=a.namespace
+            ).open_monitor()
+        except Exception as e:  # noqa: BLE001 — depth is optional
+            log.debug("queue monitor unavailable: %s", e)
     try:
         with CxiWriter(a.output, max_peaks=a.max_peaks, mode=writer_mode) as writer:
             # features already cross-checked above (one source of truth:
@@ -537,6 +571,9 @@ def main(argv=None):
             pipe = SfxPipeline(
                 variables, writer, calib=calib, config=sfx_cfg
             )
+            MetricsRegistry.default().register("sfx", pipe.metrics)
+            if monitor is not None:
+                pipe.metrics.attach_queue(monitor)
             import time
 
             t0 = time.monotonic()
@@ -551,8 +588,9 @@ def main(argv=None):
             dt = time.monotonic() - t0
             log.info(
                 "end of stream: %d events, %d peaks -> %s (%.1f s wall, "
-                "%.1f events/s incl. first-batch compile)",
+                "%.1f events/s incl. first-batch compile; %s)",
                 n, pipe.n_peaks, a.output, dt, n / dt if dt > 0 else 0.0,
+                pipe.metrics.status_line(),
             )
     except ValueError as e:
         # writer/params misconfiguration (foreign HDF5 layout, max_peaks
@@ -560,6 +598,13 @@ def main(argv=None):
         log.error("%s", e)
         return 1
     finally:
+        if metrics_server is not None:
+            metrics_server.close()
+        if monitor is not None and hasattr(monitor, "disconnect"):
+            try:
+                monitor.disconnect()
+            except Exception:  # noqa: BLE001 — already closing
+                pass
         if hasattr(queue, "disconnect"):
             queue.disconnect()
     return 0

@@ -24,6 +24,7 @@ Improvements over the reference (explicitly, per SURVEY.md §3 quirks):
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, List, Optional
 
@@ -62,6 +63,9 @@ class RingBuffer:
         self._n_put = 0
         self._n_get = 0
         self._n_put_rejected = 0
+        self._high_water = 0
+        self._last_put_t: float = -1.0  # monotonic; -1 = never
+        self._last_get_t: float = -1.0
 
     # -- reference-parity non-blocking surface ---------------------------
     def put(self, item: Any) -> bool:
@@ -74,7 +78,7 @@ class RingBuffer:
                 self._n_put_rejected += 1
                 return False
             self._q.append(item)
-            self._n_put += 1
+            self._note_put()
             self._not_empty.notify()
             return True
 
@@ -86,7 +90,7 @@ class RingBuffer:
             if not self._q:
                 return EMPTY
             item = self._q.popleft()
-            self._n_get += 1
+            self._note_get()
             self._not_full.notify()
             return item
 
@@ -104,6 +108,8 @@ class RingBuffer:
         with self._lock:
             self._check_open()
             self._q.appendleft(item)
+            if len(self._q) > self._high_water:
+                self._high_water = len(self._q)
             self._not_empty.notify()
             return True
 
@@ -120,7 +126,7 @@ class RingBuffer:
             if not ok:
                 return False
             self._q.append(item)
-            self._n_put += 1
+            self._note_put()
             self._not_empty.notify()
             return True
 
@@ -132,7 +138,7 @@ class RingBuffer:
             if not ok or not self._q:
                 return EMPTY
             item = self._q.popleft()
-            self._n_get += 1
+            self._note_get()
             self._not_full.notify()
             return item
 
@@ -148,7 +154,8 @@ class RingBuffer:
                 return []
             n = min(max_items, len(self._q))
             out = [self._q.popleft() for _ in range(n)]
-            self._n_get += n
+            if n:
+                self._note_get(n)
             if n:
                 self._not_full.notify_all()
             return out
@@ -184,12 +191,37 @@ class RingBuffer:
             raise TransportClosed(f"queue {self.name!r} is draining (shutdown)")
 
     # -- observability ---------------------------------------------------
+    def _note_put(self):
+        # caller holds self._lock
+        self._n_put += 1
+        depth = len(self._q)
+        if depth > self._high_water:
+            self._high_water = depth
+        self._last_put_t = time.monotonic()
+
+    def _note_get(self, n: int = 1):
+        # caller holds self._lock
+        self._n_get += n
+        self._last_get_t = time.monotonic()
+
     def stats(self) -> dict:
+        """Depth + lifetime counters + the health fields the stall
+        detector and stats RPC read: ``high_water`` (max depth ever seen)
+        and ``last_put_age_s``/``last_get_age_s`` (seconds since the last
+        producer/consumer touch; -1 = never) for liveness."""
         with self._lock:
+            # sampled under the lock: outside it a concurrent put/get
+            # could advance _last_put_t past `now` -> negative age
+            now = time.monotonic()
             return {
                 "depth": len(self._q),
                 "maxsize": self.maxsize,
                 "puts": self._n_put,
                 "gets": self._n_get,
                 "puts_rejected": self._n_put_rejected,
+                "high_water": self._high_water,
+                "last_put_age_s": round(now - self._last_put_t, 3) if self._last_put_t >= 0 else -1.0,
+                "last_get_age_s": round(now - self._last_get_t, 3) if self._last_get_t >= 0 else -1.0,
+                "closed": self._closed,
+                "draining": self._draining,
             }

@@ -161,6 +161,11 @@ class ShmRingBuffer:
         # the FFI round trip
         self._slot_bytes = int(self._lib.shmring_slot_bytes(handle))
         self._voids_skipped = 0
+        # serializes the read surface (stats/size — scraped from metrics
+        # HTTP threads) against disconnect()/destroy() freeing the C
+        # handle: a check-then-use on _h alone can still pass a freed
+        # pointer to C when the scrape races teardown
+        self._handle_lock = threading.Lock()
 
     def set_stall_timeout(self, seconds: float):
         """Wedge-detection window for THIS handle (0 disables): a slot
@@ -308,32 +313,57 @@ class ShmRingBuffer:
             out.append(item)
         return out
 
+    def _live_handle(self):
+        """The C handle, or TransportClosed after disconnect()/destroy().
+        The observability surfaces (stats/size — scraped by metrics
+        endpoints, possibly after teardown) must fail as a catchable
+        dead-transport error, never hand NULL to C (a segfault)."""
+        h = self._h
+        if not h:
+            raise TransportClosed(f"shm ring {self.name!r} is detached")
+        return h
+
     def size(self) -> int:
-        return int(self._lib.shmring_size(self._h))
+        with self._handle_lock:
+            return int(self._lib.shmring_size(self._live_handle()))
 
     @property
     def maxsize(self) -> int:
-        return int(self._lib.shmring_capacity(self._h))
+        with self._handle_lock:
+            return int(self._lib.shmring_capacity(self._live_handle()))
 
     @property
     def closed(self) -> bool:
-        return bool(self._lib.shmring_is_closed(self._h))
+        with self._handle_lock:
+            return bool(self._lib.shmring_is_closed(self._live_handle()))
 
     def close(self):
-        self._lib.shmring_close(self._h)
+        # no-op after disconnect()/destroy(): there is nothing left to
+        # close, and the C side dereferences the handle without a NULL
+        # check (same segfault class _live_handle guards the read surface
+        # against; teardown paths may close and detach in either order —
+        # the lock makes the check-then-use atomic vs a concurrent free)
+        with self._handle_lock:
+            if self._h:
+                self._lib.shmring_close(self._h)
 
     def begin_drain(self):
         """Half-close for graceful teardown: producer puts/reserves are
         refused (they see the closed signal, a clean exit) while gets keep
         serving. Cross-process: every attached producer observes it."""
-        self._lib.shmring_begin_drain(self._h)
+        with self._handle_lock:
+            if self._h:
+                self._lib.shmring_begin_drain(self._h)
 
     def stats(self) -> dict:
         buf = (ctypes.c_uint64 * 4)()
-        self._lib.shmring_stats(self._h, ctypes.byref(buf))
+        with self._handle_lock:
+            h = self._live_handle()
+            self._lib.shmring_stats(h, ctypes.byref(buf))
+            maxsize = int(self._lib.shmring_capacity(h))
         return {
             "depth": int(buf[0]),
-            "maxsize": self.maxsize,
+            "maxsize": maxsize,
             "puts": int(buf[1]),
             "gets": int(buf[2]),
             "puts_rejected": int(buf[3]),
@@ -342,15 +372,17 @@ class ShmRingBuffer:
 
     def disconnect(self):
         """Detach this handle (the ring survives for other processes)."""
-        if self._h:
-            self._lib.shmring_free(self._h, 0)
-            self._h = None
+        with self._handle_lock:
+            if self._h:
+                self._lib.shmring_free(self._h, 0)
+                self._h = None
 
     def destroy(self):
         """Detach AND unlink the shared memory object."""
-        if self._h:
-            self._lib.shmring_free(self._h, 1)
-            self._h = None
+        with self._handle_lock:
+            if self._h:
+                self._lib.shmring_free(self._h, 1)
+                self._h = None
 
     def __del__(self):
         try:

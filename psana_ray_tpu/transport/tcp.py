@@ -15,12 +15,15 @@ Wire protocol (all little-endian):
               'Q' (put-batch) + count:u32 + count x (len:u32 + payload)
               'O' (open) + ns_len:u16 + ns + name_len:u16 + name
                          + maxsize:u32
+              'T' (stats) — queue-health RPC: depth, high-water mark,
+                  put/get counters, liveness ages of the bound queue
               'F' (bye) — no response; acks the last delivery and ends
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
               + [G ok] len:u32 + payload   + [S] size:u32
               + [B ok] count:u32 + count x (len:u32 + payload)
               + [Q ok] accepted:u32
+              + [T ok] len:u32 + JSON stats object
 
 Delivery contract (PART OF THE WIRE PROTOCOL, not a server detail): the
 server holds each GET/B delivery as in-flight until the SAME connection's
@@ -67,6 +70,7 @@ the popped item(s).
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
@@ -75,6 +79,7 @@ from typing import Any, List, Optional
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY, RingBuffer
 from psana_ray_tpu.transport.codec import decode_payload as _decode, encode_payload as _encode
+from psana_ray_tpu.utils.metrics import probe_queue_stats
 
 _OP_PUT = b"P"
 _OP_GET = b"G"
@@ -83,12 +88,25 @@ _OP_CLOSE = b"C"
 _OP_GET_BATCH = b"B"
 _OP_PUT_BATCH = b"Q"
 _OP_OPEN = b"O"
+_OP_STATS = b"T"
 _OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
 _ST_ERR = b"E"
 
+
+
+def _queue_stats_payload(queue) -> dict:
+    """JSON-safe stats for any backing queue: full ``stats()`` when the
+    backing provides it (RingBuffer, ShmRingBuffer), depth-only otherwise.
+    A dead queue reports ``closed`` instead of erroring the whole RPC."""
+    try:
+        return probe_queue_stats(queue)
+    except TransportClosed:
+        return {"closed": True}
+    except Exception as e:  # noqa: BLE001 — stats must not kill serving
+        return {"error": repr(e)}
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -150,6 +168,23 @@ class TcpQueueServer:
     def named_queues(self) -> List[tuple]:
         with self._queues_lock:
             return sorted(self._queues)
+
+    def queues_by_name(self) -> dict:
+        """``{label: queue}`` over the default + every named queue —
+        the stall detector's dynamic watch population (labels are
+        ``default`` and ``<namespace>/<queue_name>``)."""
+        with self._queues_lock:
+            out = {f"{ns}/{nm}": q for (ns, nm), q in self._queues.items()}
+        out["default"] = self.queue
+        return out
+
+    def stats_all(self) -> dict:
+        """``{label: stats dict}`` for every queue — the server's
+        registry source (``--metrics_port`` on queue_server)."""
+        out = {}
+        for label, q in self.queues_by_name().items():
+            out[label] = _queue_stats_payload(q)
+        return out
 
     def all_queues(self) -> List[Any]:
         with self._queues_lock:  # snapshot: OPENs race with shutdown
@@ -300,6 +335,9 @@ class TcpQueueServer:
                         conn.sendall(_ST_OK + struct.pack("<I", accepted))
                     elif op == _OP_SIZE:
                         conn.sendall(_ST_OK + struct.pack("<I", queue.size()))
+                    elif op == _OP_STATS:
+                        payload = json.dumps(_queue_stats_payload(queue)).encode()
+                        conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
                     elif op == _OP_CLOSE:
                         queue.close()
                         conn.sendall(_ST_OK)
@@ -524,15 +562,44 @@ class TcpQueueClient:
         with self._lock:
             return self._retrying(_do, deadline)
 
-    def size(self) -> int:
+    # size()/stats() are observability probes (scrape threads, heartbeats,
+    # the stall detector): they must fail FAST on a dead server — the full
+    # reconnect backoff cycle (minutes, serialized under self._lock) would
+    # stall /metrics exactly during the incident the probe exists to show.
+    # Data opcodes (put/get) keep the patient default.
+    PROBE_DEADLINE_S = 5.0
+
+    def size(self, deadline: Optional[float] = None) -> int:
+        import time
+
         def _do():
             self._sock.sendall(_OP_SIZE)
             self._status()
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             return n
 
+        if deadline is None:
+            deadline = time.monotonic() + self.PROBE_DEADLINE_S
         with self._lock:
-            return self._retrying(_do)
+            return self._retrying(_do, deadline)
+
+    def stats(self, deadline: Optional[float] = None) -> dict:
+        """Queue-health RPC (opcode 'T'): depth, high-water mark, put/get
+        counters, liveness ages of the queue this connection is bound to —
+        the cross-host half of the observability story (the stall detector
+        and the Prometheus endpoint read the same dict server-side)."""
+        import time
+
+        def _do():
+            self._sock.sendall(_OP_STATS)
+            self._status()
+            (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return json.loads(_recv_exact(self._sock, n).decode())
+
+        if deadline is None:
+            deadline = time.monotonic() + self.PROBE_DEADLINE_S
+        with self._lock:
+            return self._retrying(_do, deadline)
 
     def close_remote(self):
         """Close the remote queue (fault-injection / teardown)."""

@@ -3,8 +3,11 @@
 The reference's only observability is per-event log lines and an uncalled
 ``Queue.size()`` (SURVEY.md §5 "Metrics: ... no metrics export, no
 counters"). This module provides the counters the runbook needs: frames/s,
-bytes/s, p50/p95/p99 latency (reservoir), queue depth snapshots.
-Thread-safe; pure stdlib.
+bytes/s, p50/p95/p99 latency (reservoir), queue depth snapshots, and the
+per-stage latency histograms (:class:`StageTimes`) the pipeline threads
+through the record envelope. Export (Prometheus text format over HTTP) and
+stall detection live in :mod:`psana_ray_tpu.obs`; this module stays pure
+stdlib and thread-safe so every process can afford it.
 """
 
 from __future__ import annotations
@@ -13,7 +16,19 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
+
+
+def probe_queue_stats(queue) -> Dict:
+    """One queue-health probe for every observability surface: the full
+    ``stats()`` dict when the backing provides it (RingBuffer,
+    ShmRingBuffer, TcpQueueClient), depth-only otherwise. Raises whatever
+    the backing raises — error policy (skip / report closed / drop the
+    source) belongs to the caller."""
+    stats = getattr(queue, "stats", None)
+    if callable(stats):
+        return dict(stats())
+    return {"depth": queue.size()}
 
 
 class Meter:
@@ -51,46 +66,129 @@ class Meter:
             dt = time.monotonic() - self._t0
             return self._count / dt if dt > 0 else 0.0
 
+    def snapshot(self) -> Dict[str, float]:
+        return {"total": self.count, "per_second": round(self.rate(), 3)}
+
 
 class LatencyStats:
-    """Reservoir-sampled latency quantiles (fixed memory, unbiased)."""
+    """Reservoir-sampled latency quantiles (fixed memory, unbiased).
+
+    The sorted view is CACHED and invalidated on ``observe``, so a burst of
+    quantile reads (``summary_ms`` used to sort three times per status
+    line) pays for at most one sort per new sample.
+    """
 
     def __init__(self, reservoir_size: int = 4096, seed: int = 0):
         self._lock = threading.Lock()
         self._size = reservoir_size
         self._n = 0
+        self._sum = 0.0
         self._samples: List[float] = []
+        self._sorted: Optional[List[float]] = None
         self._rng = random.Random(seed)
 
     def observe(self, seconds: float):
         with self._lock:
             self._n += 1
+            self._sum += seconds
             if len(self._samples) < self._size:
                 self._samples.append(seconds)
+                self._sorted = None
             else:
                 j = self._rng.randrange(self._n)
                 if j < self._size:
                     self._samples[j] = seconds
+                    # rejected samples (the common case once n >> size)
+                    # leave the reservoir untouched — keep the cache hot
+                    self._sorted = None
+
+    def _sorted_view(self) -> List[float]:
+        # caller holds self._lock
+        if self._sorted is None:
+            self._sorted = sorted(self._samples)
+        return self._sorted
 
     def quantile(self, q: float) -> float:
         with self._lock:
-            if not self._samples:
+            s = self._sorted_view()
+            if not s:
                 return float("nan")
-            s = sorted(self._samples)
-            idx = min(len(s) - 1, max(0, int(q * len(s))))
-            return s[idx]
+            return s[min(len(s) - 1, max(0, int(q * len(s))))]
+
+    def quantiles(self, qs: Sequence[float]) -> List[float]:
+        """All requested quantiles under ONE lock acquisition / sort."""
+        with self._lock:
+            s = self._sorted_view()
+            if not s:
+                return [float("nan")] * len(qs)
+            return [s[min(len(s) - 1, max(0, int(q * len(s))))] for q in qs]
 
     @property
     def count(self) -> int:
         with self._lock:
             return self._n
 
+    @property
+    def mean(self) -> float:
+        """Lifetime mean over ALL observations (not just the reservoir) —
+        the exact-decomposition half of the stage-timing story: per-stage
+        means telescope to the e2e mean, quantiles do not."""
+        with self._lock:
+            return self._sum / self._n if self._n else float("nan")
+
     def summary_ms(self) -> Dict[str, float]:
-        return {
-            "p50_ms": self.quantile(0.50) * 1e3,
-            "p95_ms": self.quantile(0.95) * 1e3,
-            "p99_ms": self.quantile(0.99) * 1e3,
-        }
+        p50, p95, p99 = self.quantiles((0.50, 0.95, 0.99))
+        return {"p50_ms": p50 * 1e3, "p95_ms": p95 * 1e3, "p99_ms": p99 * 1e3}
+
+    def snapshot(self) -> Dict[str, float]:
+        """JSON-safe summary; quantile keys only when samples exist (no
+        NaN leaks into exported JSON/Prometheus)."""
+        with self._lock:
+            n, total = self._n, self._sum
+            s = self._sorted_view()
+        out: Dict[str, float] = {"count": n}
+        if not s:
+            return out
+        out["mean_ms"] = round((total / n) * 1e3, 6)
+        for name, q in (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99)):
+            out[name] = round(s[min(len(s) - 1, max(0, int(q * len(s))))] * 1e3, 6)
+        return out
+
+
+class StageTimes:
+    """Named per-stage latency histograms (one :class:`LatencyStats` per
+    stage, created on first observation).
+
+    The pipeline threads monotonic hop timestamps through each record
+    (:func:`psana_ray_tpu.records.mark_hop`); consecutive hop differences
+    land here under the canonical stage names of
+    :mod:`psana_ray_tpu.obs.stages` plus the ``e2e`` pseudo-stage, so the
+    end-to-end latency decomposes exactly: the per-stage means sum to the
+    e2e mean over the same records."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, LatencyStats] = {}
+
+    def observe(self, stage: str, seconds: float):
+        st = self._stats.get(stage)
+        if st is None:
+            with self._lock:
+                st = self._stats.setdefault(stage, LatencyStats())
+        st.observe(seconds)
+
+    def stat(self, stage: str) -> Optional[LatencyStats]:
+        with self._lock:
+            return self._stats.get(stage)
+
+    def stages(self) -> List[str]:
+        with self._lock:
+            return sorted(self._stats)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            items = list(self._stats.items())
+        return {name: st.snapshot() for name, st in items}
 
 
 class PipelineMetrics:
@@ -101,7 +199,17 @@ class PipelineMetrics:
         self.bytes = Meter("bytes")
         self.batches = Meter("batches")
         self.step_latency = LatencyStats()
+        self.stages = StageTimes()
         self._queue = queue
+
+    def attach_queue(self, queue):
+        """Late-bind the transport queue whose depth the status line and
+        snapshot report (the consumer CLI connects after metrics exist)."""
+        self._queue = queue
+
+    @property
+    def has_queue(self) -> bool:
+        return self._queue is not None
 
     def observe_frame(self, nbytes: int = 0):
         self.frames.add(1)
@@ -114,6 +222,35 @@ class PipelineMetrics:
         if nbytes:
             self.bytes.add(nbytes)
         self.step_latency.observe(latency_s)
+
+    def _queue_stats(self) -> Optional[dict]:
+        q = self._queue
+        if q is None:
+            return None
+        try:
+            return probe_queue_stats(q)
+        except Exception:
+            return None
+
+    def snapshot(self) -> dict:
+        """JSON-safe nested dict — the per-process half of the cluster
+        registry's :meth:`psana_ray_tpu.obs.MetricsRegistry.snapshot`."""
+        out = {
+            "frames_total": self.frames.count,
+            "frames_per_second": round(self.frames.rate(), 3),
+            "bytes_total": self.bytes.count,
+            "bytes_per_second": round(self.bytes.rate(), 3),
+            "batches_total": self.batches.count,
+            "batches_per_second": round(self.batches.rate(), 3),
+            "step_latency": self.step_latency.snapshot(),
+        }
+        stages = self.stages.snapshot()
+        if stages:
+            out["stages"] = stages
+        qs = self._queue_stats()
+        if qs is not None:
+            out["queue"] = qs
+        return out
 
     def status_line(self) -> str:
         lat = self.step_latency.summary_ms()

@@ -112,3 +112,14 @@ def annotate(name: str):
         return jax.profiler.TraceAnnotation(name)
     except Exception:  # pragma: no cover - backend without profiler
         return contextlib.nullcontext()
+
+
+def annotate_stage(stage: str):
+    """Timeline region for one CANONICAL pipeline stage
+    (:data:`psana_ray_tpu.obs.stages.STAGES`), named ``stage.<name>`` —
+    the device-trace half of the stage-timing story: the same stage names
+    that label the latency histograms on the metrics endpoint label the
+    regions on the TensorBoard/Perfetto timeline, so a p99 outlier in
+    ``queue_dwell`` vs ``device_put`` points at the same vocabulary in
+    both tools."""
+    return annotate(f"stage.{stage}")

@@ -22,11 +22,14 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def _fresh_registry():
+    from psana_ray_tpu.obs.registry import MetricsRegistry
     from psana_ray_tpu.transport.registry import Registry
 
     Registry.reset_default()
+    MetricsRegistry.reset_default()
     yield
     Registry.reset_default()
+    MetricsRegistry.reset_default()
 
 
 @pytest.fixture

@@ -1,0 +1,458 @@
+"""Observability layer (psana_ray_tpu.obs): registry, Prometheus export,
+queue-health RPC, stall detection.
+
+Strategy mirrors SURVEY.md §4 — in-process units, no sleeps where the API
+lets us drive time explicitly (StallDetector.poll_once takes ``now``)."""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.obs import (
+    EVENT_BACKPRESSURE,
+    EVENT_CONSUMER_STALL,
+    EVENT_PRODUCER_IDLE,
+    MetricsRegistry,
+    MetricsServer,
+    StallDetector,
+    start_metrics_server,
+)
+from psana_ray_tpu.transport.ring import RingBuffer
+from psana_ray_tpu.utils.metrics import LatencyStats, PipelineMetrics
+
+# Prometheus exposition text-format 0.0.4 sample line:
+#   name{label="value"} 1.23
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?'
+    r' (?P<value>-?(?:\d+\.?\d*(?:e[+-]?\d+)?|nan|inf|-inf))$',
+    re.IGNORECASE,
+)
+
+
+def parse_prometheus(text):
+    """Validate + parse exposition text: returns {(name, labels): value}.
+    Raises on any line that is neither a comment nor a valid sample, and
+    on samples appearing before their HELP/TYPE headers."""
+    samples = {}
+    typed = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        assert m.group("name") in typed, f"sample before HELP/TYPE: {line!r}"
+        samples[(m.group("name"), m.group("labels") or "")] = float(m.group("value"))
+    return samples
+
+
+class TestRegistry:
+    def test_snapshot_shapes(self):
+        reg = MetricsRegistry()
+        pm = PipelineMetrics()
+        pm.observe_frame(100)
+        pm.observe_batch(4, 0.002, nbytes=400)
+        reg.register("consumer", pm)
+        reg.register("queue", lambda: {"depth": 3, "puts": 10})
+        snap = reg.snapshot()
+        assert snap["consumer"]["frames_total"] == 5
+        assert snap["consumer"]["bytes_total"] == 500
+        assert snap["consumer"]["batches_total"] == 1
+        assert snap["queue"] == {"depth": 3, "puts": 10}
+        json.dumps(snap)  # JSON-safe contract
+
+    def test_snapshot_survives_dead_source(self):
+        reg = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("dead transport")
+
+        reg.register("dead", boom)
+        reg.register("alive", lambda: {"depth": 1})
+        snap = reg.snapshot()
+        assert snap["alive"] == {"depth": 1}
+        assert "error" in snap["dead"]
+
+    def test_render_prometheus_valid_and_typed(self):
+        reg = MetricsRegistry()
+        pm = PipelineMetrics()
+        for _ in range(8):
+            pm.observe_frame(1000)
+        pm.observe_batch(8, 0.004, nbytes=0)
+        reg.register("consumer", pm)
+        text = reg.render_prometheus()
+        samples = parse_prometheus(text)
+        assert samples[("psana_ray_frames_total", 'source="consumer"')] == 16.0
+        assert samples[("psana_ray_bytes_total", 'source="consumer"')] == 8000.0
+        assert samples[("psana_ray_batches_total", 'source="consumer"')] == 1.0
+        # quantile gauges from the step-latency reservoir
+        assert ("psana_ray_step_latency_p50_ms", 'source="consumer"') in samples
+        assert ("psana_ray_step_latency_p99_ms", 'source="consumer"') in samples
+        # counter/gauge typing convention
+        assert "# TYPE psana_ray_frames_total counter" in text
+        assert "# TYPE psana_ray_step_latency_p50_ms gauge" in text
+
+    def test_render_escapes_and_sanitizes(self):
+        reg = MetricsRegistry()
+        reg.register('we"ird\nsource', {"bad-metric name": 1})
+        text = reg.render_prometheus()
+        samples = parse_prometheus(text)
+        assert samples == {("psana_ray_bad_metric_name", 'source="we\\"ird\\nsource"'): 1.0}
+
+    def test_last_registration_wins(self):
+        reg = MetricsRegistry()
+        reg.register("q", {"depth": 1})
+        reg.register("q", {"depth": 2})
+        assert reg.snapshot()["q"] == {"depth": 2}
+        reg.unregister("q")
+        assert reg.snapshot() == {}
+
+    def test_non_finite_and_non_numeric_leaves_skipped(self):
+        reg = MetricsRegistry()
+        reg.register("q", {"depth": 2, "rate": float("inf"), "name": "epix", "flag": True})
+        samples = parse_prometheus(reg.render_prometheus())
+        assert samples == {
+            ("psana_ray_depth", 'source="q"'): 2.0,
+            ("psana_ray_flag", 'source="q"'): 1.0,
+        }
+
+
+class TestExporter:
+    def test_http_round_trip(self):
+        """Acceptance: scrape the endpoint, get valid Prometheus text with
+        frames/bytes/batches counters and p50/p99 gauges; /healthz serves
+        the same registry as JSON."""
+        reg = MetricsRegistry()
+        pm = PipelineMetrics(queue=RingBuffer(8))
+        for _ in range(3):
+            pm.observe_frame(64)
+        pm.observe_batch(3, 0.001)
+        reg.register("consumer", pm)
+        with MetricsServer(registry=reg, host="127.0.0.1", port=0) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode()
+            samples = parse_prometheus(text)
+            assert samples[("psana_ray_frames_total", 'source="consumer"')] == 6.0
+            assert samples[("psana_ray_bytes_total", 'source="consumer"')] == 192.0
+            assert samples[("psana_ray_batches_total", 'source="consumer"')] == 1.0
+            assert ("psana_ray_step_latency_p50_ms", 'source="consumer"') in samples
+            assert ("psana_ray_step_latency_p99_ms", 'source="consumer"') in samples
+            # queue stats ride the same snapshot (attach_queue contract)
+            assert ("psana_ray_queue_depth", 'source="consumer"') in samples
+            with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+                health = json.loads(resp.read().decode())
+            assert health["consumer"]["frames_total"] == 6
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope", timeout=5)
+
+    def test_scrape_reflects_live_updates(self):
+        reg = MetricsRegistry()
+        pm = PipelineMetrics()
+        reg.register("p", pm)
+        with MetricsServer(registry=reg, host="127.0.0.1", port=0) as srv:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            before = parse_prometheus(urllib.request.urlopen(url, timeout=5).read().decode())
+            pm.observe_frame(1)
+            after = parse_prometheus(urllib.request.urlopen(url, timeout=5).read().decode())
+        assert before[("psana_ray_frames_total", 'source="p"')] == 0.0
+        assert after[("psana_ray_frames_total", 'source="p"')] == 1.0
+
+    def test_port_zero_is_off(self):
+        # the CLI contract: --metrics_port 0 starts nothing (zero cost)
+        assert start_metrics_server(0) is None
+        assert start_metrics_server(-1) is None
+        assert start_metrics_server(None) is None
+
+
+class TestQueueStatsRPC:
+    def test_ring_stats_fields(self):
+        q = RingBuffer(4)
+        from psana_ray_tpu.records import FrameRecord
+
+        rec = FrameRecord(0, 0, np.zeros((1, 4, 4), np.float32), 1.0)
+        assert q.put(rec)
+        assert q.put(rec)
+        q.get()
+        s = q.stats()
+        assert s["depth"] == 1
+        assert s["puts"] == 2
+        assert s["gets"] == 1
+        assert s["high_water"] == 2
+        assert s["maxsize"] == 4
+        assert 0 <= s["last_put_age_s"] < 60
+        assert 0 <= s["last_get_age_s"] < 60
+        assert s["closed"] is False
+
+    def test_tcp_stats_opcode(self):
+        """Queue-health RPC ('T'): a remote client reads the same stats
+        dict the server-side ring reports."""
+        from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+        srv = TcpQueueServer(RingBuffer(8), host="127.0.0.1", maxsize=8).serve_background()
+        try:
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            try:
+                assert c.put(b"abc")
+                s = c.stats()
+                assert s["depth"] == 1
+                assert s["puts"] == 1
+                assert s["high_water"] == 1
+                assert s["maxsize"] == 8
+            finally:
+                c.disconnect()
+            # server-side aggregation used by --metrics_port on the server
+            labels = srv.stats_all()
+            assert labels["default"]["depth"] == 1
+        finally:
+            srv.shutdown()
+
+
+class _FakeQueue:
+    """stats()-bearing stub whose counters the test scripts directly."""
+
+    def __init__(self, depth=0, maxsize=4, puts=0, gets=0):
+        self.d = {"depth": depth, "maxsize": maxsize, "puts": puts, "gets": gets}
+
+    def stats(self):
+        return dict(self.d)
+
+
+class TestStallDetector:
+    def test_backpressure_fires_once_and_rearms(self):
+        q = _FakeQueue(depth=4, maxsize=4, puts=10, gets=6)
+        det = StallDetector(full_threshold_s=5.0, idle_threshold_s=1e9)
+        det.watch("epix", q)
+        det.poll_once(now=100.0)
+        assert not det.events  # below threshold
+        det.poll_once(now=106.0)
+        events = list(det.events)
+        assert [e.kind for e in events] == [EVENT_BACKPRESSURE]
+        assert events[0].queue == "epix"
+        assert events[0].depth == 4 and events[0].maxsize == 4
+        json.loads(events[0].to_json())  # structured contract
+        # same episode: no duplicate warning
+        det.poll_once(now=120.0)
+        assert len(det.events) == 1
+        # condition clears -> re-arms -> fires again on the next episode
+        q.d["depth"] = 1
+        det.poll_once(now=121.0)
+        q.d["depth"] = 4
+        det.poll_once(now=122.0)
+        det.poll_once(now=128.0)
+        assert [e.kind for e in det.events] == [EVENT_BACKPRESSURE] * 2
+        assert det.snapshot()[f"{EVENT_BACKPRESSURE}_total"] == 2
+
+    def test_consumer_stall_on_blocked_queue(self):
+        """Acceptance: the detector fires on an artificially blocked queue
+        (items sitting, no consumer progress)."""
+        q = RingBuffer(2)
+        from psana_ray_tpu.records import FrameRecord
+
+        rec = FrameRecord(0, 0, np.zeros((1, 4, 4), np.float32), 1.0)
+        assert q.put(rec) and q.put(rec)  # full, nobody reading
+        fired = []
+        det = StallDetector(
+            full_threshold_s=5.0, idle_threshold_s=10.0, on_event=fired.append
+        )
+        det.watch("blocked", q)
+        t0 = time.monotonic()
+        det.poll_once(now=t0)          # baseline (counter deltas need one)
+        det.poll_once(now=t0 + 6.0)    # backpressure threshold crossed;
+        # the frozen-gets episode starts HERE (first poll where the get
+        # counter is observably unchanged)
+        det.poll_once(now=t0 + 17.0)   # idle threshold crossed too
+        kinds = {e.kind for e in fired}
+        assert kinds == {EVENT_BACKPRESSURE, EVENT_CONSUMER_STALL}
+        snap = det.snapshot()
+        assert snap[f"{EVENT_BACKPRESSURE}_total"] == 1
+        assert snap[f"{EVENT_CONSUMER_STALL}_total"] == 1
+
+    def test_producer_idle(self):
+        q = _FakeQueue(depth=0, maxsize=4, puts=7, gets=7)
+        det = StallDetector(idle_threshold_s=10.0)
+        det.watch("starved", q)
+        det.poll_once(now=50.0)  # baseline
+        det.poll_once(now=51.0)  # frozen-puts episode starts here
+        det.poll_once(now=62.0)
+        assert [e.kind for e in det.events] == [EVENT_PRODUCER_IDLE]
+        # progress resumes -> clears
+        q.d["puts"] = 8
+        q.d["depth"] = 1
+        q.d["gets"] = 8
+        q.d["depth"] = 0
+        det.poll_once(now=62.0)
+        assert len(det.events) == 1
+
+    def test_healthy_queue_stays_quiet_and_rates(self):
+        q = _FakeQueue(depth=1, maxsize=4, puts=0, gets=0)
+        det = StallDetector(full_threshold_s=1.0, idle_threshold_s=2.0)
+        det.watch("ok", q)
+        for i in range(10):
+            q.d["puts"] += 10
+            q.d["gets"] += 10
+            det.poll_once(now=100.0 + i)
+        assert not det.events
+        assert det.snapshot()["ok"]["put_rate"] == pytest.approx(10.0)
+        assert det.snapshot()["ok"]["get_rate"] == pytest.approx(10.0)
+
+    def test_dynamic_provider_and_registry_source(self):
+        det = StallDetector(full_threshold_s=1.0)
+        det.watch_provider(lambda: {"late": _FakeQueue(depth=4, maxsize=4)})
+        det.poll_once(now=10.0)
+        det.poll_once(now=12.0)
+        assert [e.kind for e in det.events] == [EVENT_BACKPRESSURE]
+        reg = MetricsRegistry()
+        reg.register("stalls", det)
+        samples = parse_prometheus(reg.render_prometheus())
+        assert samples[("psana_ray_backpressure_total", 'source="stalls"')] == 1.0
+
+    def test_background_thread_lifecycle(self):
+        q = _FakeQueue(depth=4, maxsize=4)
+        det = StallDetector(poll_interval_s=0.01, full_threshold_s=0.02)
+        det.watch("bg", q)
+        with det:
+            deadline = time.monotonic() + 5.0
+            while not det.events and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert [e.kind for e in det.events] == [EVENT_BACKPRESSURE]
+
+
+class TestLatencyStatsSatellite:
+    """Satellite: quantile caching — correct across interleaved observes,
+    and summary_ms costs one sort, not three."""
+
+    def test_quantiles_correct_after_cache_invalidation(self):
+        ls = LatencyStats()
+        for v in (0.005, 0.001, 0.003):
+            ls.observe(v)
+        assert ls.quantile(0.5) == 0.003
+        ls.observe(0.002)  # invalidates the cached sort
+        assert ls.quantile(0.5) == 0.003
+        assert ls.quantile(0.0) == 0.001
+        assert ls.quantile(0.99) == 0.005
+        s = ls.summary_ms()
+        assert s["p50_ms"] == pytest.approx(3.0)
+        assert s["p99_ms"] == pytest.approx(5.0)
+
+    def test_summary_sorts_once(self):
+        ls = LatencyStats()
+        for v in range(100):
+            ls.observe(v / 1000.0)
+        calls = {"n": 0}
+        orig = sorted
+
+        def counting_sorted(x):
+            calls["n"] += 1
+            return orig(x)
+
+        import builtins
+
+        try:
+            builtins.sorted = counting_sorted
+            ls.summary_ms()
+            ls.summary_ms()  # cached: no further sort
+        finally:
+            builtins.sorted = orig
+        assert calls["n"] == 1
+
+    def test_mean_is_lifetime_not_reservoir(self):
+        ls = LatencyStats(reservoir_size=4, seed=1)
+        for v in range(100):
+            ls.observe(float(v))
+        assert ls.count == 100
+        assert ls.mean == pytest.approx(np.mean(np.arange(100.0)))
+        snap = ls.snapshot()
+        assert snap["count"] == 100
+        assert snap["mean_ms"] == pytest.approx(ls.mean * 1e3)
+
+    def test_empty_snapshot_has_no_nan(self):
+        snap = LatencyStats().snapshot()
+        assert snap == {"count": 0}
+        assert np.isnan(LatencyStats().quantile(0.5))
+
+
+class TestConsumerHeartbeatFlag:
+    def test_consumer_cli_takes_status_interval_and_metrics_port(self):
+        """Satellite: the flags parse (the heartbeat behavior itself is
+        covered by the e2e stage-timing test via PipelineMetrics)."""
+        from psana_ray_tpu import consumer
+
+        # smoke: main's parser accepts the flags without hitting transport
+        with pytest.raises(SystemExit) as e:
+            consumer.main(["--help"])
+        assert e.value.code == 0
+
+    def test_status_line_includes_queue_depth(self):
+        pm = PipelineMetrics(queue=RingBuffer(4))
+        line = pm.status_line()
+        assert "depth" in line
+
+
+class TestMetricsServerConcurrency:
+    def test_parallel_scrapes(self):
+        reg = MetricsRegistry()
+        pm = PipelineMetrics()
+        reg.register("p", pm)
+        errors = []
+
+        def scrape(url):
+            try:
+                for _ in range(5):
+                    parse_prometheus(
+                        urllib.request.urlopen(url, timeout=5).read().decode()
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        with MetricsServer(registry=reg, host="127.0.0.1", port=0) as srv:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            threads = [threading.Thread(target=scrape, args=(url,)) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for _ in range(50):
+                pm.observe_frame(1)
+            for t in threads:
+                t.join()
+        assert not errors
+
+
+class TestMultihostLegRegistration:
+    def test_legs_register_under_detector_names(self):
+        """MultiDetectorGlobalConsumer puts every leg on the process
+        metrics endpoint: explicit obs_name wins, unnamed legs get their
+        detector key."""
+        jax = pytest.importorskip("jax")
+        from jax.sharding import Mesh
+
+        from psana_ray_tpu.infeed.multihost import (
+            GlobalStreamConsumer,
+            MultiDetectorGlobalConsumer,
+        )
+        from psana_ray_tpu.obs import MetricsRegistry
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        named = GlobalStreamConsumer(
+            RingBuffer(maxsize=4), local_batch_size=2, mesh=mesh,
+            frame_shape=(1, 4, 4), obs_name="epix_custom",
+        )
+        unnamed = GlobalStreamConsumer(
+            RingBuffer(maxsize=4), local_batch_size=2, mesh=mesh,
+            frame_shape=(1, 4, 4),
+        )
+        MultiDetectorGlobalConsumer({"epix": named, "jungfrau": unnamed})
+        sources = MetricsRegistry.default().sources()
+        assert "multihost.epix_custom" in sources  # explicit name kept
+        assert "multihost.epix" not in sources  # not double-registered
+        assert "multihost.jungfrau" in sources  # auto-named by detector
+        assert unnamed.obs_name == "jungfrau"

@@ -1,0 +1,159 @@
+"""Static NameError screen over the package (satellite of ISSUE 1).
+
+The seed shipped ``List[float]`` in utils/metrics.py with ``List`` never
+imported — invisible to the suite because ``from __future__ import
+annotations`` defers evaluation, but a latent NameError for any consumer
+that introspects the annotations. This test makes that class of bug a
+tier-1 failure: pyflakes when the environment has it, else a conservative
+stdlib AST checker that flags loads of names never bound anywhere in the
+module (no false positives by construction: any binding anywhere in the
+file — any scope — whitelists the name).
+
+Fast (< 1 s for the whole package) and dependency-free, so it is always
+``-m 'not slow'``-eligible.
+"""
+
+import ast
+import builtins
+import pathlib
+
+import pytest
+
+PACKAGE_ROOT = pathlib.Path(__file__).resolve().parent.parent
+SOURCES = sorted((PACKAGE_ROOT / "psana_ray_tpu").rglob("*.py")) + [
+    PACKAGE_ROOT / "bench.py"
+]
+
+# Module-level / implicit names that are defined without an AST binding.
+_IMPLICIT = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__annotations__",
+    "__class__", "__path__", "__qualname__", "__module__", "__dict__",
+}
+_ALLOWED = set(dir(builtins)) | _IMPLICIT
+
+
+class _Binder(ast.NodeVisitor):
+    """Collect every name the module binds, in ANY scope (conservative:
+    scope-blind union, so cross-scope uses never false-positive)."""
+
+    def __init__(self):
+        self.bound = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.bound.add(node.id)
+        self.generic_visit(node)
+
+    def _bind_args(self, args: ast.arguments):
+        for a in (
+            *args.posonlyargs, *args.args, *args.kwonlyargs,
+            *filter(None, (args.vararg, args.kwarg)),
+        ):
+            self.bound.add(a.arg)
+
+    def visit_FunctionDef(self, node):
+        self.bound.add(node.name)
+        self._bind_args(node.args)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.bound.add(node.name)
+        self._bind_args(node.args)
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node):
+        self._bind_args(node.args)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            self.bound.add(alias.asname or alias.name.split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for alias in node.names:
+            if alias.name != "*":
+                self.bound.add(alias.asname or alias.name)
+
+    def visit_ExceptHandler(self, node):
+        if node.name:
+            self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Global(self, node):
+        self.bound.update(node.names)
+
+    def visit_Nonlocal(self, node):
+        self.bound.update(node.names)
+
+    def visit_MatchAs(self, node):
+        if node.name:
+            self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_MatchStar(self, node):
+        if node.name:
+            self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_MatchMapping(self, node):
+        if node.rest:
+            self.bound.add(node.rest)
+        self.generic_visit(node)
+
+
+def undefined_names(tree: ast.AST):
+    """``[(lineno, name), ...]`` loads of names never bound in the file."""
+    binder = _Binder()
+    binder.visit(tree)
+    known = binder.bound | _ALLOWED
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id not in known
+        ):
+            out.append((node.lineno, node.id))
+    return out
+
+
+def _pyflakes_messages(path):
+    """Real pyflakes when available (richer: unused imports stay advisory,
+    undefined names fail); None when the environment lacks it."""
+    try:
+        from pyflakes import api as pyflakes_api
+        from pyflakes import reporter as pyflakes_reporter
+    except ImportError:
+        return None
+    import io
+
+    buf = io.StringIO()
+    rep = pyflakes_reporter.Reporter(buf, buf)
+    pyflakes_api.checkPath(str(path), reporter=rep)
+    return [
+        line
+        for line in buf.getvalue().splitlines()
+        # fail only on NameError-class findings; style findings (unused
+        # import, redefinition) stay out of tier-1
+        if "undefined name" in line or "local variable" in line and "referenced before" in line
+    ]
+
+
+@pytest.mark.parametrize("path", SOURCES, ids=lambda p: str(p.relative_to(PACKAGE_ROOT)))
+def test_no_undefined_names(path):
+    src = path.read_text()
+    tree = ast.parse(src, filename=str(path))  # syntax is checked for free
+    flakes = _pyflakes_messages(path)
+    if flakes is not None:
+        assert not flakes, "pyflakes: " + "; ".join(flakes)
+        return
+    missing = undefined_names(tree)
+    assert not missing, (
+        f"{path.name}: names used but never bound (latent NameError): "
+        + ", ".join(f"line {ln}: {name}" for ln, name in missing)
+    )

@@ -813,6 +813,15 @@ def main():
             lambda: _bench_e2e_streaming(jax, calib, pool, batch_size, extras, wd),
         )
 
+    # ---------------- host datapath: copies/allocs per frame -------------
+    # device-free accounting of the zero-copy rework (ISSUE 2): TCP
+    # relay fps plus measured copies/frame and steady-state allocs/frame
+    run_section(
+        wd,
+        "host-datapath",
+        lambda: _bench_host_datapath(extras, smoke),
+    )
+
     # ---------------- config 5: multi-detector fan-in --------------------
     # two independent sections: the kHz HOST demonstration must not lose
     # its number to a tunnel-bound device leg timing out (round-3 run:
@@ -2098,6 +2107,106 @@ def _fanin_host_pass(det_a, det_b, n_a, n_b, batch_a, batch_b, extras, prefix, l
                 r.destroy()
             except Exception:
                 pass
+
+
+def _bench_host_datapath(extras, smoke=False):
+    """Host-datapath accounting (no device): stream detector-native u16
+    frames producer-client -> TCP queue server (loopback) -> batched
+    consumer, and report — measured, not inferred — the per-frame memory
+    discipline of the zero-copy rework alongside its fps:
+
+    - ``host_datapath_tcp_fps``: relay throughput through one server;
+    - ``host_datapath_copies_per_frame``: consumer-side payload memcpys
+      (utils.bufpool.WIRE counters; 1.0 = wire -> batch-arena only);
+    - ``host_datapath_allocs_per_frame``: steady-state pool misses per
+      frame past warmup (0.0 = every recv buffer recycled);
+    - pool gauges (leases/hits/misses) under ``host_datapath_pool``.
+
+    Producer-side accounting rides the same counters: sendmsg scatter-
+    gather means a put performs no payload copy at all, so the producer
+    contributes 0 to copies/frame here (the server relay contributes 0
+    as well — it forwards the pooled buffer it received into).
+    """
+    import threading as _threading
+
+    from psana_ray_tpu.infeed.batcher import batches_from_queue
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+    from psana_ray_tpu.utils.bufpool import BufferPool, WIRE
+
+    shape = (2, 32, 32) if smoke else (16, 352, 384)  # epix10k2M u16
+    n_frames = 32 if smoke else 192
+    batch_size = 8 if smoke else 32
+    rng = np.random.default_rng(7)
+    pool16 = [rng.integers(0, 4096, size=shape, dtype=np.uint16) for _ in range(4)]
+
+    # queue depth bounds the pool's working set (every queued frame holds
+    # a pooled lease): one batch of headroom keeps the relay busy without
+    # ballooning retained buffers
+    srv = TcpQueueServer(RingBuffer(batch_size), host="127.0.0.1").serve_background()
+    prod = TcpQueueClient("127.0.0.1", srv.port)
+    cons = TcpQueueClient("127.0.0.1", srv.port)
+    buf_pool = BufferPool.default()
+
+    def produce(warmup: int):
+        total = warmup + n_frames
+        for i in range(total):
+            rec = FrameRecord(0, i, pool16[i % 4], 9.5)
+            if not prod.put_wait(rec, timeout=120.0):
+                raise RuntimeError("producer starved out")
+        if not prod.put_wait(EndOfStream(total_events=total), timeout=120.0):
+            raise RuntimeError("EOS delivery timed out")
+
+    try:
+        warmup = 3 * batch_size  # let the pool reach its working-set peak
+        t = _threading.Thread(target=produce, args=(warmup,), daemon=True)
+        seen = 0
+        t0 = time.perf_counter()
+        m0 = None
+        # copies are exactly per-frame, so count them over the WHOLE
+        # stream (a steady-state mark would land mid-pop: the batch
+        # source copies a pop's frames before yielding, skewing a
+        # windowed ratio); allocs genuinely need the steady window
+        c0 = WIRE.stats()
+        t.start()
+        for batch in batches_from_queue(cons, batch_size, poll_interval_s=0.001):
+            seen += batch.num_valid
+            if m0 is None and seen >= warmup:  # steady state begins
+                m0 = buf_pool.stats()
+                t0 = time.perf_counter()
+                seen_at_mark = seen
+        dt = time.perf_counter() - t0
+        t.join()
+        if m0 is None:  # stream died before steady state: no number
+            raise RuntimeError(f"only {seen} frames before EOS; no steady window")
+        c1, m1 = WIRE.stats(), buf_pool.stats()
+        steady = max(1, seen - seen_at_mark)
+        fps = steady / dt
+        copies = (c1["copies_total"] - c0["copies_total"]) / max(1, seen)
+        # steady-state churn only: a miss that raised the class's
+        # concurrency high-water is working-set growth (those buffers
+        # never existed before), not a per-frame allocation
+        allocs = (m1["churn_misses"] - m0["churn_misses"]) / steady
+        growth = (m1["misses"] - m0["misses"]) / steady
+        extras["host_datapath_tcp_fps"] = round(fps, 1)
+        extras["host_datapath_copies_per_frame"] = round(copies, 3)
+        extras["host_datapath_allocs_per_frame"] = round(allocs, 3)
+        extras["host_datapath_pool_growth_per_frame"] = round(growth, 3)
+        extras["host_datapath_pool"] = m1
+        log(
+            f"host datapath [tcp relay, u16 {shape}]: {fps:.0f} fps, "
+            f"{copies:.2f} copies/frame, {allocs:.3f} allocs/frame "
+            f"steady-state (pool: {m1['hits']} hits / {m1['misses']} "
+            f"misses, {m1['churn_misses']} churn)"
+        )
+    finally:
+        for c in (prod, cons):
+            try:
+                c.disconnect()
+            except Exception:
+                pass
+        srv.shutdown()
 
 
 def _bench_fanin_host(extras, smoke=False):

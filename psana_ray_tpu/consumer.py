@@ -19,6 +19,7 @@ cross-host transports.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 from psana_ray_tpu.config import TransportConfig
@@ -77,6 +78,13 @@ class DataReader:
         self.close()
 
     # -- reads ------------------------------------------------------------
+    # Ownership note (zero-copy datapath, ISSUE 2): over the pooled TCP
+    # transport a returned FrameRecord's panels may VIEW a recycled
+    # receive buffer, kept checked out by ``rec.lease`` for the record's
+    # lifetime (released on GC, or eagerly by the batcher's push_view).
+    # Reading ``rec.panels`` while you hold the record is always safe;
+    # to retain the pixels past the record, copy them (or call
+    # ``rec.materialize()``).
     def read(self) -> Any:
         """Non-blocking read: FrameRecord | EndOfStream | None (empty).
         Parity: data_reader.py:31-37, with typed EOS instead of None."""
@@ -127,8 +135,15 @@ class DataReader:
                     # starved while holding a sibling's marker: put it back
                     # NOW — two consumers each holding the marker the other
                     # needs would otherwise deadlock, both waiting on an
-                    # empty queue with flush gated on a successful read
-                    tally.flush_duplicates(self._queue)
+                    # empty queue with flush gated on a successful read.
+                    # When we DID return markers, sleep before reading
+                    # again: the flush and our next pop share one GIL
+                    # slice, so without the yield we snatch our own
+                    # marker back before the blocked sibling ever wakes —
+                    # the measured 60+ s livelock behind the
+                    # test_two_consumers_two_runtimes flake
+                    if tally.flush_duplicates(self._queue):
+                        time.sleep(0.05)
                     continue
                 tally.flush_duplicates(self._queue)  # a slot just freed
                 if is_eos(item):
@@ -260,8 +275,6 @@ def main(argv=None):
     # only exist when their flags ask for them (zero cost disabled).
     # Started AFTER every early-return validation above, so a refused run
     # never leaks the bound port or the heartbeat thread.
-    import time as _time
-
     from psana_ray_tpu.obs import MetricsRegistry, start_metrics_server
     from psana_ray_tpu.obs.stages import STAGE_QUEUE_DWELL
     from psana_ray_tpu.utils.metrics import PipelineMetrics
@@ -302,7 +315,7 @@ def main(argv=None):
                         # wall-clock dwell (producer stamp -> this read):
                         # exact same-host, approximate cross-host (NTP)
                         metrics.stages.observe(
-                            STAGE_QUEUE_DWELL, max(0.0, _time.time() - rec.timestamp)
+                            STAGE_QUEUE_DWELL, max(0.0, time.time() - rec.timestamp)
                         )
                     if not a.quiet:
                         log.info(

@@ -21,6 +21,7 @@ from psana_ray_tpu.obs.stages import HOP_BATCH, HOP_DEQ, HOP_PUSH
 from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord
 from psana_ray_tpu.transport.recovery import return_to_queue
 from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
+from psana_ray_tpu.utils.bufpool import WIRE
 
 
 class StreamStalled(RuntimeError):
@@ -97,13 +98,17 @@ class FrameBatcher:
     PERF_NOTES.md). CONTRACT: a pooled Batch's arrays are overwritten
     ``n_buffers`` batches later, so ``n_buffers`` must EXCEED the maximum
     number of batches simultaneously alive anywhere downstream — queued
-    in a prefetcher or merge queue, held by the consumer, or still being
+    in a prefetcher or merge queue, held by the consumer, still being
     transferred (an async/aliasing device_put may read the host buffer
     after the batcher moved on; on CPU backends the "device" array can
-    alias the pooled memory outright). :class:`~psana_ray_tpu.infeed.
-    pipeline.InfeedPipeline` validates its own bound; direct users must
-    size it themselves. The default (0) keeps the always-fresh behavior,
-    safe for consumers that retain batches indefinitely.
+    alias the pooled memory outright), or sitting un-yielded in
+    :func:`batches_from_queue`'s ready list (it defers yields until
+    every transport lease from a pop is released, so one completed
+    batch — plus the tail at EOS — counts as alive while the next arena
+    is acquired). :class:`~psana_ray_tpu.infeed.pipeline.InfeedPipeline`
+    validates its own bound; direct users must size it themselves. The
+    default (0) keeps the always-fresh behavior, safe for consumers
+    that retain batches indefinitely.
     """
 
     def __init__(
@@ -158,6 +163,7 @@ class FrameBatcher:
         frames, valid, rank, idx, energy = self._cur
         i = self._fill
         frames[i] = rec.panels
+        WIRE.add(rec.panels.nbytes)  # THE consumer-side memcpy (wire obs)
         valid[i] = 1
         rank[i] = rec.shard_rank
         idx[i] = rec.event_idx
@@ -172,6 +178,22 @@ class FrameBatcher:
         if self._fill == self.batch_size:
             return self._emit()
         return None
+
+    def push_view(self, rec: FrameRecord) -> Optional[Batch]:
+        """``push`` for zero-copy records: copy the panels into the
+        batch-arena slot, then release the record's transport-buffer
+        lease (pooled TCP recv buffer, shm ring slot). The release
+        happens strictly AFTER the copy — crash-redelivery semantics
+        depend on a leased buffer never returning to its pool while the
+        payload could still be needed — and makes the consumer side
+        exactly ONE memcpy (wire -> batch slot). No-op release for
+        records that own their data, so callers need not distinguish."""
+        try:
+            return self.push(rec)
+        finally:
+            release = getattr(rec, "release", None)
+            if release is not None:
+                release()
 
     def flush(self) -> Optional[Batch]:
         """Pad + emit the tail batch (EOS flush). None when nothing pends."""
@@ -234,12 +256,17 @@ def batches_from_queue(
     batcher: Optional[FrameBatcher] = None
     starved_since: Optional[float] = None
     tally = EosTally()
+    # zero-copy drain when the transport offers it (shm ring): records
+    # view transport memory and are copied+released per push below —
+    # copies/frame drops to exactly one. Pooled TCP clients return
+    # lease-backed records from plain get_batch already.
+    pop = getattr(queue, "get_batch_view", None) or queue.get_batch
     try:
         while True:
             if stop is not None and stop.is_set():
                 return
             try:
-                items = queue.get_batch(batch_size, timeout=poll_interval_s)
+                items = pop(batch_size, timeout=poll_interval_s)
             except TransportWedged:
                 # a peer crashed mid-claim and frames are stuck behind the
                 # wedge: this is data loss, NOT a clean end of stream —
@@ -253,8 +280,14 @@ def batches_from_queue(
                 return
             if not items:
                 # starved: return any held sibling markers (cross-holding
-                # consumers would otherwise deadlock — see iter_records)
-                tally.flush_duplicates(queue)
+                # consumers would otherwise deadlock — see iter_records).
+                # When markers WERE returned, sleep before polling again:
+                # the flush and our next pop share one GIL slice, so
+                # without the yield we pop our own marker straight back
+                # and the blocked sibling never gets it (the competing-
+                # consumer livelock; see EosTally.flush_duplicates)
+                if tally.flush_duplicates(queue):
+                    time.sleep(max(poll_interval_s, 0.02))
                 now = time.monotonic()
                 starved_since = starved_since if starved_since is not None else now
                 if max_wait_s is not None and now - starved_since >= max_wait_s:
@@ -270,6 +303,16 @@ def batches_from_queue(
             starved_since = None
             t_deq = time.monotonic()
             tally.flush_duplicates(queue)  # gets just freed slots
+            # Every record from this pop is copied-and-released BEFORE any
+            # yield: a generator suspended at yield (slow consumer, full
+            # prefetch queue) must not sit on transport leases — over the
+            # shm ring a held slot blocks producers and, past the wedge
+            # timeout, would misdiagnose the stall as a crashed peer.
+            # The deferred batch counts as ALIVE for the n_buffers arena
+            # contract (see FrameBatcher docstring; InfeedPipeline budgets
+            # prefetch_depth + 4 for it).
+            ready: List[Batch] = []
+            stream_done = False
             for pos, item in enumerate(items):
                 if isinstance(item, EndOfStream):
                     if tally.process(item):
@@ -282,19 +325,30 @@ def batches_from_queue(
                             if isinstance(rest, EndOfStream):
                                 tally.process(rest)
                             else:
-                                leftover_frames.append(rest)
+                                # materialize BEFORE re-enqueueing: a view-
+                                # backed leftover still occupies the very
+                                # transport slot/buffer a put may need
+                                # (self-deadlock against a full ring)
+                                leftover_frames.append(
+                                    rest.materialize() if hasattr(rest, "materialize") else rest
+                                )
                         if leftover_frames:
                             return_to_queue(queue, leftover_frames, what="re-popped record")
                         if batcher is not None and (tail := batcher.flush()) is not None:
-                            yield tail
-                        return
+                            ready.append(tail)
+                        stream_done = True
+                        break
                     continue
                 if batcher is None:
                     batcher = FrameBatcher(batch_size, n_buffers=n_buffers)
                 if item.hops is not None:  # timed stream: stamp the pop
                     item.hops[HOP_DEQ] = t_deq
-                out = batcher.push(item)
+                out = batcher.push_view(item)  # copy into arena, release lease
                 if out is not None:
-                    yield out
+                    ready.append(out)
+            del items  # drop any lingering record refs with the pop
+            yield from ready
+            if stream_done:
+                return
     finally:
         tally.flush_duplicates(queue, final=True)

@@ -80,14 +80,15 @@ class FanInPipeline:
         self.streams = list(streams)
         merge_maxsize = max(1, merge_depth) * len(self.streams)
         for s in self.streams:
-            floor = s.prefetch_depth + merge_maxsize + 3
+            floor = s.prefetch_depth + merge_maxsize + 4
             if 0 < s.batcher_buffers < floor:
                 # worst case every merge slot holds this leg's batches on
-                # top of its own prefetch queue + consumer + fill + margin
+                # top of its own prefetch queue + consumer + fill + the
+                # batch source's deferred un-yielded batch + margin
                 raise ValueError(
                     f"stream {s.name!r}: batcher_buffers={s.batcher_buffers} "
                     f"can recycle a batch still alive in the merge; need "
-                    f">= prefetch_depth + merge capacity + 3 = {floor}"
+                    f">= prefetch_depth + merge capacity + 4 = {floor}"
                 )
         self._pipes: Dict[str, InfeedPipeline] = {}
         try:

@@ -6,7 +6,16 @@ device compute and host transfer never overlap. Here a background thread
 stages the next ``prefetch_depth`` batches onto the devices while the
 current batch computes, so at steady state the TPU never waits for host
 transfer (the classic double-buffering pattern; depth 2 suffices when
-transfer < compute)."""
+transfer < compute).
+
+Host-side memory discipline (ISSUE 2): the batch source underneath
+(``batches_from_queue``) drains zero-copy when the transport offers it
+and copies each record ONCE into the batch arena (``FrameBatcher.
+push_view``), releasing the transport buffer lease immediately after —
+so the full queue -> batch -> device path performs one host memcpy per
+frame plus the H2D transfer, with steady-state allocations handled by
+the recv pool (``utils/bufpool.py``) and optional batch-arena recycling
+(``batcher_buffers``)."""
 
 from __future__ import annotations
 
@@ -204,13 +213,17 @@ class InfeedPipeline:
         ``infeed.<name>`` in the process :class:`~psana_ray_tpu.obs.
         MetricsRegistry` (unregistered on :meth:`close`), so a
         ``--metrics_port`` endpoint in the same process exposes it."""
-        if batcher_buffers > 0 and batcher_buffers < prefetch_depth + 3:
+        if batcher_buffers > 0 and batcher_buffers < prefetch_depth + 4:
             # alive at once: prefetch_depth queued + 1 with the consumer
-            # + 1 being filled + 1 margin for an async/aliasing device_put
+            # + 1 being filled + 1 deferred un-yielded in the batch
+            # source (batches_from_queue releases every transport lease
+            # before yielding, so a completed batch — and the tail at
+            # EOS — can sit in its ready list while the next arena is
+            # acquired) + 1 margin for an async/aliasing device_put
             raise ValueError(
                 f"batcher_buffers={batcher_buffers} can recycle a batch "
-                f"still alive downstream; need >= prefetch_depth + 3 = "
-                f"{prefetch_depth + 3} (see FrameBatcher.n_buffers contract)"
+                f"still alive downstream; need >= prefetch_depth + 4 = "
+                f"{prefetch_depth + 4} (see FrameBatcher.n_buffers contract)"
             )
         self.queue = queue
         self.batch_size = batch_size

@@ -42,7 +42,10 @@ class _Sender:
     ``put_batch`` (TCP) so the cross-host path pays one round trip per N
     frames instead of the reference's one RPC per event (``producer.py:
     101``, SURVEY.md §3.1). In-process/shm puts are memcpys — those stay
-    per-event (batch size 1)."""
+    per-event (batch size 1). Over TCP the batch leaves via ``sendmsg``
+    scatter-gather straight from each record's panel memory
+    (``FrameRecord.wire_parts``): a producer put performs ZERO payload
+    copies."""
 
     def __init__(self, queue, backoff, stop_event, metrics, batch_size: int = 16):
         self.queue = queue

@@ -121,7 +121,10 @@ def main(argv=None):
     # Observability: every queue (default + OPENed named ones) as a
     # registry source, the Prometheus endpoint over it, and the stall
     # detector watching the same dynamic population. All three are
-    # zero-cost when their flags are off.
+    # zero-cost when their flags are off. The relay's recv-buffer pool
+    # self-registers as the `bufpool` source (leases/hits/misses) with
+    # payload-copy counters under `wire` — the zero-copy datapath's
+    # steady state is visible on the same endpoint.
     MetricsRegistry.default().register("queue_server", server.stats_all)
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
     stall = None

@@ -22,6 +22,8 @@ from typing import Optional
 
 import numpy as np
 
+from psana_ray_tpu.utils.bufpool import WIRE
+
 SCHEMA_VERSION = 2
 
 # Wire format magics (little-endian u32).
@@ -71,6 +73,14 @@ class FrameRecord:
     # zero cost for streams nobody is timing. Cross-process, the wall-clock
     # ``timestamp`` field is the enqueue-side stamp consumers fall back to.
     hops: Optional[dict] = dataclasses.field(default=None, repr=False)
+    # Host-local buffer ownership (never on the wire): when ``panels`` is
+    # a zero-copy view into pooled/transport memory, ``lease`` keeps that
+    # memory checked out (utils.bufpool.Lease or a transport slot lease).
+    # The view is valid for the record's lifetime; :meth:`release` hands
+    # the buffer back once the payload has been copied onward
+    # (FrameBatcher.push_view), and GC of the record releases as a
+    # backstop. None (the default) means the record owns its data.
+    lease: Optional[object] = dataclasses.field(default=None, repr=False)
 
     def __post_init__(self):
         panels = np.asarray(self.panels)
@@ -93,25 +103,67 @@ class FrameRecord:
             and np.array_equal(self.panels, other.panels)
         )
 
+    # -- host buffer ownership -------------------------------------------
+    def release(self):
+        """Return the leased transport buffer (if any) to its pool.
+
+        Call ONLY after the panel payload has been copied onward — the
+        view in ``panels`` dies with the lease. Idempotent; no-op for
+        records that own their data."""
+        lease = self.lease
+        if lease is not None:
+            object.__setattr__(self, "lease", None)
+            lease.release()
+
+    def materialize(self) -> "FrameRecord":
+        """Self if this record owns its data; otherwise a copy that does,
+        with the lease released. Use before re-enqueueing or retaining a
+        view-backed record past its transport buffer (e.g. frames handed
+        back to a queue whose slots those very leases occupy)."""
+        if self.lease is None:
+            return self
+        panels = self.panels.copy()
+        WIRE.add(panels.nbytes)
+        self.release()
+        # replace() carries every other field — including the hops dict,
+        # so stage timing survives materialization
+        return dataclasses.replace(self, panels=panels, lease=None)
+
     # -- wire format ------------------------------------------------------
-    def to_bytes(self) -> bytes:
-        panels = np.ascontiguousarray(self.panels)
-        dtype_code = _DTYPE_CODES[panels.dtype]
+    def wire_parts(self) -> tuple:
+        """``(header_bytes, payload_memoryview)`` — the scatter-gather
+        form of :meth:`to_bytes`. The header covers magic through shape;
+        the payload is a ZERO-COPY flat byte view of the panels (one
+        ``ascontiguousarray`` copy only if the panels are strided), so a
+        ``socket.sendmsg`` sender never materializes the frame as a
+        fresh bytes object. ``b"".join(wire_parts())`` == ``to_bytes()``."""
+        panels = self.panels
+        if not panels.flags.c_contiguous:
+            panels = np.ascontiguousarray(panels)
+            WIRE.add(panels.nbytes)
         header = _FRAME_HEADER.pack(
             _FRAME_MAGIC,
             self.schema_version,
             self.shard_rank,
             self.event_idx,
             panels.ndim,
-            dtype_code,
+            _DTYPE_CODES[panels.dtype],
             float(self.photon_energy),
             float(self.timestamp),
-        )
-        shape = struct.pack(f"<{panels.ndim}q", *panels.shape)
-        return header + shape + panels.tobytes()
+        ) + struct.pack(f"<{panels.ndim}q", *panels.shape)
+        return header, panels.data.cast("B")
+
+    def to_bytes(self) -> bytes:
+        header, payload = self.wire_parts()
+        return header + payload.tobytes()
 
     @staticmethod
-    def from_bytes(buf: bytes) -> "FrameRecord":
+    def from_bytes(buf, copy: bool = True) -> "FrameRecord":
+        """Decode one frame. ``copy=True`` (default): the record owns its
+        panels. ``copy=False``: ``panels`` is a zero-copy ``frombuffer``
+        view into ``buf`` — the caller must keep ``buf`` alive/unchanged
+        for the record's lifetime (the pooled transports do this by
+        attaching the buffer's lease to the record)."""
         magic, version, rank, idx, ndim, dtype_code, energy, ts = _FRAME_HEADER.unpack_from(buf, 0)
         if magic != _FRAME_MAGIC:
             raise ValueError(f"bad frame magic {magic:#x}")
@@ -123,12 +175,14 @@ class FrameRecord:
         if dtype_code not in _CODE_DTYPES:
             raise ValueError(f"unknown dtype code {dtype_code}")
         dtype = _CODE_DTYPES[dtype_code]
-        n = int(np.prod(shape)) * dtype.itemsize
         panels = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)), offset=off).reshape(shape)
+        if copy:
+            panels = panels.copy()
+            WIRE.add(panels.nbytes)
         return FrameRecord(
             shard_rank=rank,
             event_idx=idx,
-            panels=panels.copy(),
+            panels=panels,
             photon_energy=energy,
             timestamp=ts,
             schema_version=version,
@@ -249,53 +303,83 @@ class EosTally:
             return self.complete
         return self.observe(eos)
 
-    def flush_duplicates(self, queue, final: bool = False) -> None:
-        """Return held sibling markers to ``queue``. Cheap no-op when none
-        pend. Call after reads (a get just freed a slot) and once more on
-        exit with ``final=True`` (persistent, so the markers survive this
-        consumer). A closed transport discards them — the sibling sees the
-        dead queue itself.
+    def flush_duplicates(self, queue, final: bool = False) -> int:
+        """Return held sibling markers to ``queue``; returns how many were
+        placed. Cheap no-op when none pend. Call after reads (a get just
+        freed a slot) and once more on exit with ``final=True``
+        (persistent, so the markers survive this consumer). A closed
+        transport discards them — the sibling sees the dead queue itself.
+
+        CALLERS THAT FLUSH WHILE STARVED MUST YIELD THE SCHEDULER when
+        this returns nonzero before reading again: the very next read
+        would otherwise pop the marker straight back (put and pop happen
+        inside one GIL slice), and two competing consumers each cycling
+        their own sibling-bound marker this way never hand them over —
+        a livelock measured at 60+ s on 1-2 cores
+        (test_two_consumers_two_runtimes).
 
         The final flush routes through the shared recovery path
         (:func:`psana_ray_tpu.transport.recovery.return_to_queue`): head
         placement when supported, timed retries + logged drop otherwise."""
         if not self._pending_dups:
-            return
+            return 0
         if final:
             from psana_ray_tpu.transport.recovery import return_to_queue
 
+            n = len(self._pending_dups)
             return_to_queue(queue, self._pending_dups, what="sibling EOS marker")
             self._pending_dups = []
-            return
+            return n
         from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 
         kept = []
+        placed = 0
         for eos in self._pending_dups:
             try:
                 if not queue.put(eos):
                     kept.append(eos)
+                else:
+                    placed += 1
             except TransportWedged:
                 raise  # crashed-peer wedge is an error, not a drained queue
             except TransportClosed:
                 self._pending_dups = []
-                return
+                return placed
         self._pending_dups = kept
+        return placed
 
     @property
     def complete(self) -> bool:
         return sum(self._shards_by_rank.values()) >= self._total
 
 
-def decode(buf: bytes):
+def decode(buf, lease=None):
     """Decode a wire message into FrameRecord or EndOfStream. Accepts any
-    buffer protocol object (bytes, memoryview into shared memory, ...);
-    the returned record owns its data (panels are copied out)."""
+    buffer protocol object (bytes, memoryview into shared memory, ...).
+
+    Without ``lease`` (default) the returned record owns its data
+    (panels are copied out). With ``lease`` — a checked-out buffer that
+    ``buf`` views (utils.bufpool.Lease or a transport slot lease) — a
+    FrameRecord is returned ZERO-COPY: its panels view ``buf`` and the
+    lease rides on the record (released after the batch copy by
+    ``FrameBatcher.push_view``, or on GC). Non-frame messages never need
+    the buffer past decode, so their lease is released here."""
     (magic,) = struct.unpack_from("<I", buf, 0)
     if magic == _FRAME_MAGIC:
-        return FrameRecord.from_bytes(buf)
-    if magic == _EOS_MAGIC:
-        return EndOfStream.from_bytes(buf)
-    raise ValueError(f"unknown wire magic {magic:#x}")
+        if lease is None:
+            return FrameRecord.from_bytes(buf)
+        rec = FrameRecord.from_bytes(buf, copy=False)
+        object.__setattr__(rec, "lease", lease)
+        return rec
+    try:
+        if magic == _EOS_MAGIC:
+            return EndOfStream.from_bytes(buf)
+        raise ValueError(f"unknown wire magic {magic:#x}")
+    finally:
+        # released only AFTER the payload is fully parsed: the pool may
+        # hand a released buffer to another thread immediately
+        if lease is not None:
+            lease.release()
 
 
 def encoded_size(item) -> int:
@@ -337,6 +421,7 @@ def encode_into(item, buf) -> int:
     off += 8 * panels.ndim
     dst = np.frombuffer(mv, dtype=panels.dtype, count=panels.size, offset=off)
     np.copyto(dst, panels.reshape(-1))
+    WIRE.add(panels.nbytes)
     return off + int(panels.nbytes)
 
 

@@ -4,15 +4,18 @@ One leading tag byte selects the codec: ``R`` = records wire format
 (:mod:`psana_ray_tpu.records` — FrameRecord/EndOfStream), ``P`` = pickle
 (arbitrary Python objects), ``V`` = void (a slot committed by a producer
 whose encode failed mid-write; consumers skip it). The zero-copy shm path
-writes tag + record directly into slot memory (`shm_ring.put`); this
-module provides the bytes-building variant for transports that need a
-contiguous payload (TCP framing) and the shared decoder.
+writes tag + record directly into slot memory (`shm_ring.put`); TCP
+framing uses the scatter-gather form (:func:`encode_payload_parts` +
+``socket.sendmsg``) so a frame is never materialized as a contiguous
+bytes object; :func:`encode_payload` remains for callers that genuinely
+need one buffer. The shared decoder accepts an optional buffer lease for
+zero-copy records (see :func:`psana_ray_tpu.records.decode`).
 """
 
 from __future__ import annotations
 
 import pickle
-from typing import Any
+from typing import Any, List
 
 from psana_ray_tpu.records import EndOfStream, FrameRecord, decode
 
@@ -21,19 +24,50 @@ TAG_PICKLE = b"P"
 TAG_VOID = b"V"
 
 
+def encode_payload_parts(item: Any) -> List[Any]:
+    """``[tag+header bytes, payload buffer...]`` for scatter-gather send.
+
+    For a FrameRecord the panel payload is the record's own memory
+    (``wire_parts`` memoryview — zero copies here); everything else is a
+    single small bytes part. ``b"".join(map(bytes, parts))`` equals
+    :func:`encode_payload` for every item."""
+    if isinstance(item, FrameRecord):
+        header, payload = item.wire_parts()
+        return [TAG_RECORD + header, payload]
+    if isinstance(item, EndOfStream):
+        return [TAG_RECORD + item.to_bytes()]
+    return [TAG_PICKLE + pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)]
+
+
+def payload_nbytes(parts: List[Any]) -> int:
+    """Total wire length of :func:`encode_payload_parts` output."""
+    return sum(p.nbytes if isinstance(p, memoryview) else len(p) for p in parts)
+
+
 def encode_payload(item: Any) -> bytes:
     if isinstance(item, (FrameRecord, EndOfStream)):
         return TAG_RECORD + item.to_bytes()
     return TAG_PICKLE + pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
 
 
-def decode_payload(buf) -> Any:
-    """Decode a tagged payload; accepts bytes or memoryview. Returned
-    records own their data (panels copied out of ``buf``)."""
+def decode_payload(buf, lease=None) -> Any:
+    """Decode a tagged payload; accepts bytes or memoryview.
+
+    Without ``lease`` the returned records own their data (panels copied
+    out of ``buf``). With ``lease`` (a checked-out pool buffer that
+    ``buf`` views), frame records are returned zero-copy with the lease
+    attached — see :func:`psana_ray_tpu.records.decode` for the
+    ownership contract; non-record payloads release the lease here."""
     tag = bytes(buf[:1])
     body = buf[1:]
     if tag == TAG_RECORD:
-        return decode(body)
-    if tag == TAG_PICKLE:
-        return pickle.loads(body)
-    raise ValueError(f"unknown payload tag {tag!r}")
+        return decode(body, lease=lease)
+    try:
+        if tag == TAG_PICKLE:
+            return pickle.loads(body)
+        raise ValueError(f"unknown payload tag {tag!r}")
+    finally:
+        # after the parse, not before: a released buffer may be re-leased
+        # by another thread while ``body`` is still being read
+        if lease is not None:
+            lease.release()

@@ -18,6 +18,7 @@ The C library builds on demand with ``make`` (g++); see
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import pickle
 import subprocess
@@ -32,6 +33,8 @@ from psana_ray_tpu.transport.codec import TAG_VOID as _TAG_VOID
 from psana_ray_tpu.transport.codec import decode_payload
 from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 from psana_ray_tpu.transport.ring import EMPTY
+
+logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libshmring.so")
@@ -146,6 +149,44 @@ def native_available() -> bool:
         return False
 
 
+class _SlotLease:
+    """A consumed-but-unreleased ring slot backing a zero-copy record.
+
+    ``get_batch_view`` hands out records whose panels view slot memory
+    directly; this lease keeps the slot out of producers' hands until
+    the payload has been copied onward (``FrameBatcher.push_view``
+    releases right after the batch-arena copy). Idempotent; also fires
+    on GC, so a dropped record frees its slot instead of wedging the
+    ring. Holds the ring object itself — the mapping cannot be detached
+    by GC while any slot lease is alive, and release after an explicit
+    disconnect/destroy degrades to a no-op instead of touching a freed
+    C handle."""
+
+    __slots__ = ("_ring", "_ticket", "_released")
+
+    def __init__(self, ring: "ShmRingBuffer", ticket: int):
+        self._ring = ring
+        self._ticket = ticket
+        self._released = False
+
+    def release(self):
+        if self._released:
+            return
+        self._released = True
+        ring = self._ring
+        self._ring = None
+        with ring._handle_lock:
+            ring._slot_leases -= 1
+            if ring._h:
+                ring._lib.shmring_release(ring._h, self._ticket)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
 class ShmRingBuffer:
     """MPMC shared-memory queue; create on one process, attach on others."""
 
@@ -161,11 +202,14 @@ class ShmRingBuffer:
         # the FFI round trip
         self._slot_bytes = int(self._lib.shmring_slot_bytes(handle))
         self._voids_skipped = 0
+        self._slot_leases = 0  # outstanding zero-copy gets (see _SlotLease)
         # serializes the read surface (stats/size — scraped from metrics
         # HTTP threads) against disconnect()/destroy() freeing the C
         # handle: a check-then-use on _h alone can still pass a freed
-        # pointer to C when the scrape races teardown
-        self._handle_lock = threading.Lock()
+        # pointer to C when the scrape races teardown. REENTRANT because
+        # a _SlotLease can release from __del__ — cyclic GC may run it on
+        # the very thread that already holds this lock
+        self._handle_lock = threading.RLock()
 
     def set_stall_timeout(self, seconds: float):
         """Wedge-detection window for THIS handle (0 disables): a slot
@@ -258,6 +302,19 @@ class ShmRingBuffer:
         return True
 
     def get(self) -> Any:
+        return self._get(view=False)
+
+    def get_view(self) -> Any:
+        """Zero-copy get: a FrameRecord's panels VIEW the ring slot, the
+        slot stays claimed, and the record carries a :class:`_SlotLease`
+        — release it (``rec.release()`` / ``FrameBatcher.push_view``)
+        right after copying the payload onward. Each outstanding lease
+        keeps one slot from producers, so never hold many across
+        blocking waits. Non-frame payloads decode as owned objects with
+        the slot released immediately (same as :meth:`get`)."""
+        return self._get(view=True)
+
+    def _get(self, view: bool) -> Any:
         # loops past void slots (producer-side encode failures): a void is
         # consumed-and-skipped, NOT "empty" — real items may sit right
         # behind it, and reporting EMPTY here could convince a get_wait
@@ -272,14 +329,24 @@ class ShmRingBuffer:
                 raise TransportClosed(f"shm ring {self.name!r} is closed")
             if n == -4:
                 raise TransportWedged(self._wedged_msg("producer", "committed"))
-            try:
-                mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
-                if bytes(mv[:1]) == _TAG_VOID:
-                    self._voids_skipped += 1
-                    continue
-                return self._decode(mv)
-            finally:
+            mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
+            if bytes(mv[:1]) == _TAG_VOID:
+                self._voids_skipped += 1
                 self._lib.shmring_release(self._h, ticket)
+                continue
+            if not view:
+                try:
+                    return self._decode(mv)  # copies panels out of the slot
+                finally:
+                    self._lib.shmring_release(self._h, ticket)
+            with self._handle_lock:
+                self._slot_leases += 1
+            lease = _SlotLease(self, int(ticket.value))
+            try:
+                return decode_payload(mv, lease=lease)
+            except BaseException:
+                lease.release()
+                raise
 
     def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.0002) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -301,13 +368,29 @@ class ShmRingBuffer:
             time.sleep(poll_s)
 
     def get_batch(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
+        return self._get_batch(max_items, timeout, view=False)
+
+    def get_batch_view(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
+        """Batch drain with ZERO-COPY records (see :meth:`get_view`):
+        the one-memcpy consumer path ``batches_from_queue`` prefers when
+        the transport offers it. Blocks only for the first item; every
+        returned frame holds its slot until released, so consume the
+        batch promptly (the batcher copies + releases per record)."""
+        return self._get_batch(max_items, timeout, view=True)
+
+    def _get_batch(self, max_items: int, timeout: Optional[float], view: bool) -> List[Any]:
         out = []
-        first = self.get_wait(timeout=timeout)
-        if first is EMPTY:
-            return out
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:  # blocking first-get, matching get_wait's poll loop
+            first = self._get(view)
+            if first is not EMPTY:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                return out
+            time.sleep(0.0002)
         out.append(first)
         while len(out) < max_items:
-            item = self.get()
+            item = self._get(view)
             if item is EMPTY:
                 break
             out.append(item)
@@ -373,6 +456,7 @@ class ShmRingBuffer:
     def disconnect(self):
         """Detach this handle (the ring survives for other processes)."""
         with self._handle_lock:
+            self._warn_live_leases("disconnect")
             if self._h:
                 self._lib.shmring_free(self._h, 0)
                 self._h = None
@@ -380,9 +464,21 @@ class ShmRingBuffer:
     def destroy(self):
         """Detach AND unlink the shared memory object."""
         with self._handle_lock:
+            self._warn_live_leases("destroy")
             if self._h:
                 self._lib.shmring_free(self._h, 1)
                 self._h = None
+
+    def _warn_live_leases(self, what: str):
+        # caller holds _handle_lock. Unmapping under a zero-copy record's
+        # panels view is use-after-munmap; surface it loudly — the fix is
+        # to release (push_view/materialize) before teardown.
+        if self._h and self._slot_leases > 0:
+            logger.warning(
+                "%s(%s) with %d zero-copy slot lease(s) outstanding — "
+                "views into this ring become invalid",
+                what, self.name, self._slot_leases,
+            )
 
     def __del__(self):
         try:

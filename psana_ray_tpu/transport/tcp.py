@@ -63,6 +63,21 @@ created them (parity: ``lifetime="detached"``, ``shared_queue.py:35``).
 
 Payloads reuse the shm codec (records wire format / tagged pickle).
 
+Zero-copy datapath (ISSUE 2): frame payloads are never materialized as
+fresh bytes on either side of the socket. Sends go out via
+``socket.sendmsg`` scatter-gather straight from the record's panel
+memory (``FrameRecord.wire_parts``); receives land via ``recv_into`` in
+recycled leases from the process :class:`~psana_ray_tpu.utils.bufpool.
+BufferPool` and decode as VIEWS of that memory, with the lease riding
+the record until the payload is copied onward (``FrameBatcher.
+push_view``) or the record dies. The server's relay path is therefore
+alloc-free and copy-free per brokered frame at steady state: a PUT's
+pooled buffer is the very memory a later GET response streams from.
+This composes with the delivery contract below — an in-flight record's
+lease is released only when the record itself is dropped after the
+implicit ACK (or re-enqueued intact on connection death), never while
+redelivery could still need the payload.
+
 In-flight items are never dropped on a consumer crash: if the connection
 dies between the queue pop and the response write, the server re-enqueues
 the popped item(s).
@@ -78,7 +93,12 @@ from typing import Any, List, Optional
 
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY, RingBuffer
-from psana_ray_tpu.transport.codec import decode_payload as _decode, encode_payload as _encode
+from psana_ray_tpu.transport.codec import (
+    decode_payload as _decode,
+    encode_payload_parts as _encode_parts,
+    payload_nbytes as _parts_nbytes,
+)
+from psana_ray_tpu.utils.bufpool import BufferPool
 from psana_ray_tpu.utils.metrics import probe_queue_stats
 
 _OP_PUT = b"P"
@@ -109,14 +129,120 @@ def _queue_stats_payload(queue) -> dict:
         return {"error": repr(e)}
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_into(sock: socket.socket, mv: memoryview) -> None:
+    """Fill ``mv`` exactly from ``sock`` with ``recv_into`` — the wire
+    payload lands in caller-owned (pooled) memory with ZERO intermediate
+    bytes objects and linear cost. THE one receive primitive of this
+    module: every read, control or payload, goes through here."""
+    got = 0
+    n = len(mv)
+    while got < n:
+        k = sock.recv_into(mv[got:])
+        if not k:
             raise ConnectionError("peer closed")
-        buf.extend(chunk)
+        got += k
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Exactly ``n`` bytes for CONTROL fields (opcodes, lengths — a few
+    bytes). Frame payloads must use :func:`_recv_into` on a pooled
+    buffer instead. Linear: fills one preallocated buffer in place (the
+    old chunked ``recv()`` + accumulate pattern re-copied the prefix on
+    every chunk)."""
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
     return bytes(buf)
+
+
+# sendmsg scatter-gather: bounded iovec count per call (Linux IOV_MAX is
+# 1024; staying far below keeps each call cheap to assemble) with partial
+# sends resumed mid-part. Falls back to sendall-per-part where sendmsg is
+# unavailable (non-POSIX).
+_SENDMSG_IOV = 64
+# consecutive parts at or below this size are joined before sending:
+# copying a run of few-byte control fields (opcodes, lengths, record
+# headers) is free and keeps the iovec count low for small-record
+# batches, while frame payloads above it always pass through zero-copy
+_COALESCE_MAX = 4096
+
+
+def _sendmsg_all(sock: socket.socket, parts) -> None:
+    """Send every buffer in ``parts`` without concatenating the large
+    ones — the scatter-gather complement of :func:`_recv_into`. A 4.3 MB
+    frame goes from the record's own panel memory to the kernel in one
+    hop; the old ``b"".join`` path paid a frame-sized copy per message.
+    Runs of tiny control parts are coalesced (see ``_COALESCE_MAX``)."""
+    bufs = []
+    small: List[memoryview] = []
+
+    def _flush_small():
+        if not small:
+            return
+        bufs.append(small[0] if len(small) == 1 else memoryview(b"".join(small)))
+        small.clear()
+
+    for p in parts:
+        m = p if isinstance(p, memoryview) else memoryview(p)
+        if not m.nbytes:
+            continue
+        if m.nbytes <= _COALESCE_MAX:
+            small.append(m)
+            if sum(s.nbytes for s in small) >= _COALESCE_MAX:
+                _flush_small()
+        else:
+            _flush_small()
+            bufs.append(m)
+    _flush_small()
+    if not hasattr(sock, "sendmsg"):  # platform fallback: copy-free per part
+        for m in bufs:
+            sock.sendall(m)
+        return
+    i = 0
+    while i < len(bufs):
+        sent = sock.sendmsg(bufs[i : i + _SENDMSG_IOV])
+        if sent <= 0:
+            raise ConnectionError("peer closed during sendmsg")
+        while sent > 0:
+            m = bufs[i]
+            if sent >= m.nbytes:
+                sent -= m.nbytes
+                i += 1
+            else:
+                bufs[i] = m[sent:]
+                sent = 0
+
+
+# Upper bound on one tagged payload (u32 on the wire allows 4 GiB): a
+# corrupt or hostile length field must not size a pool lease — the
+# largest real frame (jungfrau4M f64) is ~67 MB, so 256 MB is generous.
+# Oversized lengths surface as ConnectionError so the server's in-flight
+# requeue path runs (the stream is desynced; the connection must die).
+_MAX_PAYLOAD = 256 * 1024 * 1024
+
+
+def _recv_payload(sock: socket.socket, n: int, pool: BufferPool):
+    """Receive an ``n``-byte tagged payload into a pooled buffer and
+    decode it. Frame records come back ZERO-COPY (panels view the pooled
+    buffer, lease attached — see records.decode); other payloads release
+    the lease at decode. On any failure the lease goes straight back."""
+    if n > _MAX_PAYLOAD:
+        raise ConnectionError(
+            f"payload length {n} exceeds wire maximum {_MAX_PAYLOAD}"
+        )
+    lease = pool.lease(n)
+    try:
+        _recv_into(sock, lease.mv)
+        return _decode(lease.mv, lease=lease)
+    except BaseException:
+        lease.release()  # idempotent: double-release after decode is safe
+        raise
+
+
+def _send_response_payload(conn: socket.socket, item) -> None:
+    """One ``status + len + payload`` response, scatter-gather."""
+    parts = _encode_parts(item)
+    head = _ST_OK + struct.pack("<I", _parts_nbytes(parts))
+    _sendmsg_all(conn, [head, *parts])
 
 
 class TcpQueueServer:
@@ -131,9 +257,15 @@ class TcpQueueServer:
         port: int = 0,
         maxsize: int = 100,
         queue_factory=None,
+        pool: Optional[BufferPool] = None,
     ):
         self.queue = queue if queue is not None else RingBuffer(maxsize)
         self._maxsize = maxsize
+        # recv-buffer pool for the relay path: every PUT payload lands in
+        # a recycled lease and is decoded zero-copy, so a brokered frame
+        # costs no allocation per hop (the lease returns to the pool when
+        # the frame's delivery is acknowledged and the record dies)
+        self._pool = pool if pool is not None else BufferPool.default()
         # factory for OPENed queues: (namespace, name, maxsize) -> queue.
         # Default in-process rings; a server may hand out shm-backed rings
         # instead so local clients can bypass TCP (queue_server.py --shm)
@@ -291,11 +423,14 @@ class TcpQueueServer:
                 try:
                     if op == _OP_PUT:
                         (n,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        payload = _recv_exact(conn, n)  # read BEFORE any
-                        if self._draining:              # refusal: no desync
+                        # read BEFORE any refusal: no desync. The payload
+                        # lands in a pooled lease; frames decode zero-copy
+                        # and ride the queue still viewing that buffer
+                        item = _recv_payload(conn, n, self._pool)
+                        if self._draining:
                             conn.sendall(_ST_CLOSED)
                             continue
-                        ok = queue.put(_decode(payload))
+                        ok = queue.put(item)
                         conn.sendall(_ST_OK if ok else _ST_NO)
                     elif op == _OP_GET:
                         item = queue.get()
@@ -303,33 +438,32 @@ class TcpQueueServer:
                             conn.sendall(_ST_NO)
                         else:
                             in_flight = [item]  # held until the next opcode
-                            payload = _encode(item)
-                            conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
+                            _send_response_payload(conn, item)
                     elif op == _OP_GET_BATCH:
                         (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
                         items = queue.get_batch(min(max_items, 4096), timeout=0.0)
                         in_flight = list(items)  # held until the next opcode
                         parts = [_ST_OK, struct.pack("<I", len(items))]
                         for item in items:
-                            payload = _encode(item)
-                            parts.append(struct.pack("<I", len(payload)))
-                            parts.append(payload)
-                        conn.sendall(b"".join(parts))
+                            item_parts = _encode_parts(item)
+                            parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
+                            parts.extend(item_parts)
+                        _sendmsg_all(conn, parts)
                     elif op == _OP_PUT_BATCH:
                         # read the WHOLE request before touching the queue:
                         # an error mid-put (closed transport) must not leave
                         # half the request unread and desync the stream
                         (count,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        payloads = []
+                        batch = []
                         for _ in range(count):
                             (n,) = struct.unpack("<I", _recv_exact(conn, 4))
-                            payloads.append(_recv_exact(conn, n))
+                            batch.append(_recv_payload(conn, n, self._pool))
                         if self._draining:
                             conn.sendall(_ST_CLOSED)
                             continue
                         accepted = 0
-                        for payload in payloads:
-                            if not queue.put(_decode(payload)):
+                        for item in batch:
+                            if not queue.put(item):
                                 break  # full: accepted prefix only (FIFO)
                             accepted += 1
                         conn.sendall(_ST_OK + struct.pack("<I", accepted))
@@ -429,9 +563,14 @@ class TcpQueueClient:
         maxsize: int = 0,
         reconnect_tries: int = 4,
         reconnect_base_s: float = 0.5,
+        pool: Optional[BufferPool] = None,
     ):
         self.host, self.port = host, port
         self._timeout_s = timeout_s
+        # pooled receive staging: GET/B payloads land via recv_into in
+        # recycled leases and decode zero-copy (consumer-side copy count
+        # drops to the single batch-arena copy; see FrameBatcher.push_view)
+        self._pool = pool if pool is not None else BufferPool.default()
         self._reconnect_tries = reconnect_tries
         self._reconnect_base_s = reconnect_base_s
         self._binding: Optional[tuple] = None  # (ns, name, maxsize) to replay
@@ -541,10 +680,17 @@ class TcpQueueClient:
 
     # -- contract ---------------------------------------------------------
     def put(self, item: Any, deadline: Optional[float] = None) -> bool:
-        payload = _encode(item)
+        # scatter-gather: the frame payload goes to the kernel straight
+        # from the record's panel memory (wire_parts memoryview) — no
+        # to_bytes() serialization copy, no request-assembly concat copy
+        parts = _encode_parts(item)
+        n = _parts_nbytes(parts)
+        if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
+            raise ValueError(f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}")
+        head = _OP_PUT + struct.pack("<I", n)
 
         def _do():
-            self._sock.sendall(_OP_PUT + struct.pack("<I", len(payload)) + payload)
+            _sendmsg_all(self._sock, [head, *parts])
             return self._status() == _ST_OK
 
         with self._lock:
@@ -557,7 +703,7 @@ class TcpQueueClient:
             if st == _ST_NO:
                 return EMPTY
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
-            return _decode(_recv_exact(self._sock, n))
+            return _recv_payload(self._sock, n, self._pool)
 
         with self._lock:
             return self._retrying(_do, deadline)
@@ -657,7 +803,7 @@ class TcpQueueClient:
             out = []
             for _ in range(count):
                 (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
-                out.append(_decode(_recv_exact(self._sock, n)))
+                out.append(_recv_payload(self._sock, n, self._pool))
             return out
 
         with self._lock:
@@ -665,16 +811,20 @@ class TcpQueueClient:
 
     def put_batch(self, items: List[Any]) -> int:
         """Send N items in ONE round trip (opcode 'Q'); returns how many
-        the server accepted (a full queue truncates — retry the rest)."""
-        payloads = [_encode(i) for i in items]
-        parts = [_OP_PUT_BATCH, struct.pack("<I", len(payloads))]
-        for p in payloads:
-            parts.append(struct.pack("<I", len(p)))
-            parts.append(p)
-        request = b"".join(parts)
+        the server accepted (a full queue truncates — retry the rest).
+        Scatter-gather like :meth:`put`: N frames leave straight from
+        their panel memory, never assembled into one request buffer."""
+        parts = [_OP_PUT_BATCH + struct.pack("<I", len(items))]
+        for item in items:
+            item_parts = _encode_parts(item)
+            n = _parts_nbytes(item_parts)
+            if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
+                raise ValueError(f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}")
+            parts.append(struct.pack("<I", n))
+            parts.extend(item_parts)
 
         def _do():
-            self._sock.sendall(request)
+            _sendmsg_all(self._sock, parts)
             self._status()
             (accepted,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             return accepted

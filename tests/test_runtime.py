@@ -193,7 +193,9 @@ class TestMultiRuntimeEos:
             time.sleep(delay_b)
             rts[1].run(block=True)
 
-        tb = threading.Thread(target=_delayed)
+        # daemonic: a runtime wedged behind a starved consumer must fail
+        # the test, not hang the pytest process at exit
+        tb = threading.Thread(target=_delayed, daemon=True)
         tb.start()
         return rts, tb
 
@@ -222,7 +224,18 @@ class TestMultiRuntimeEos:
         assert {e.producer_rank for e in eos} == {0, 1}
         assert all(e.total_shards == 2 and e.shards_done == 1 for e in eos)
 
+    # measured 6-8/30 flaky on a 1-core host at a flat 30 s join
+    # (CHANGES.md). Root cause was NOT starvation: two competing
+    # consumers each re-popped their own flushed sibling EOS marker
+    # within one GIL slice, never handing it over — a livelock fixed at
+    # the source (EosTally.flush_duplicates callers now yield after a
+    # starved flush; see consumer.iter_records). Hardened here too: the
+    # join deadline scales with core scarcity and the workers are
+    # daemonic, so a regression fails the test instead of wedging the
+    # pytest session at exit. 0/30 failures post-fix on the 1-core box.
     def test_two_consumers_two_runtimes(self):
+        import os
+
         rts, tb = self._two_runtimes(num_events=12, delay_b=0.3, num_consumers=2)
         results = {}
 
@@ -230,11 +243,21 @@ class TestMultiRuntimeEos:
             with DataReader() as reader:
                 results[cid] = [r.event_idx for r in reader]
 
-        threads = [threading.Thread(target=consume, args=(c,)) for c in range(2)]
+        threads = [
+            threading.Thread(target=consume, args=(c,), daemon=True) for c in range(2)
+        ]
         for t in threads:
             t.start()
+        # two consumers + two producer runtimes timeshare the machine:
+        # give the 30 s budget a 4-way-parallelism baseline (120 s on one
+        # core, 30 s at >= 4)
+        join_s = 30.0 * max(1.0, 4.0 / (os.cpu_count() or 1))
+        deadline = time.monotonic() + join_s
         for t in threads:
-            t.join(timeout=30)
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        assert not any(t.is_alive() for t in threads), (
+            f"competing consumers starved past the {join_s:.0f}s join deadline"
+        )
         rts[0].join()
         tb.join()
         all_idx = sorted(results[0] + results[1])

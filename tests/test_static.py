@@ -157,3 +157,83 @@ def test_no_undefined_names(path):
         f"{path.name}: names used but never bound (latent NameError): "
         + ", ".join(f"line {ln}: {name}" for ln, name in missing)
     )
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy invariant screen (ISSUE 2 satellite): the transport/infeed hot
+# path must not regrow per-frame allocation idioms. Every frame payload
+# travels as (a) a wire_parts() memoryview out via sendmsg, (b) a pooled
+# recv_into lease in, (c) ONE np.copyto into the batch arena — so
+# `.tobytes()` (frame-sized serialization copy), `.to_bytes(` calls
+# (contiguous assembly), raw `.recv(` (fresh bytes per chunk) and
+# frame-scale `bytes(...)` materialization are BANNED in these files,
+# except for the reviewed, size-bounded uses below.
+
+import re  # noqa: E402
+
+HOT_PATH_FILES = [
+    "psana_ray_tpu/records.py",
+    "psana_ray_tpu/transport/codec.py",
+    "psana_ray_tpu/transport/tcp.py",
+    "psana_ray_tpu/transport/shm_ring.py",
+    "psana_ray_tpu/infeed/batcher.py",
+]
+
+_BANNED = [
+    # frame-sized ndarray -> bytes serialization copy
+    ("tobytes", re.compile(r"\.tobytes\(")),
+    # record -> contiguous bytes assembly (wire_parts exists instead)
+    ("to_bytes-call", re.compile(r"\.to_bytes\(")),
+    # chunked recv(): a fresh bytes object per chunk; use _recv_into on
+    # a pooled buffer (recv_into is fine and not matched)
+    ("raw-recv", re.compile(r"\.recv\(")),
+    # bytes(...) materialization of a buffer (lookbehind skips nbytes(,
+    # from_bytes(, slot_bytes( etc.)
+    ("bytes-materialize", re.compile(r"(?<![A-Za-z0-9_.])bytes\(")),
+]
+
+# (file suffix, line substring) — each entry is a REVIEWED exception:
+# control-plane reads of a few bytes, 1-byte tag peeks, or the legacy
+# contiguous encoders that back-compat callers still use off the hot
+# path. An entry that stops matching fails the test too (allowlist rot).
+_HOT_ALLOWLIST = [
+    ("transport/tcp.py", "return bytes(buf)"),  # _recv_exact: <=8-byte control fields
+    ("transport/codec.py", "return [TAG_RECORD + item.to_bytes()]"),  # EOS: header-only
+    ("transport/codec.py", "return TAG_RECORD + item.to_bytes()"),  # legacy encode_payload
+    ("transport/codec.py", "tag = bytes(buf[:1])"),  # 1-byte tag peek
+    ("transport/shm_ring.py", "if bytes(mv[:1]) == _TAG_VOID:"),  # 1-byte tag peek
+    ("records.py", "return header + payload.tobytes()"),  # legacy FrameRecord.to_bytes
+    ("records.py", "data = item.to_bytes()  # header-only, tiny"),  # encode_into EOS
+]
+
+
+def _allowed(rel: str, line: str) -> bool:
+    return any(rel.endswith(suf) and sub in line for suf, sub in _HOT_ALLOWLIST)
+
+
+def test_hot_path_has_no_per_frame_allocation_idioms():
+    violations, matched_allow = [], set()
+    for rel in HOT_PATH_FILES:
+        path = PACKAGE_ROOT / rel
+        for ln, line in enumerate(path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0] if not line.lstrip().startswith("#") else ""
+            if not code.strip():
+                continue
+            for tag, pat in _BANNED:
+                if not pat.search(code):
+                    continue
+                if _allowed(rel, line):
+                    matched_allow.add((rel, line.strip()))
+                    continue
+                violations.append(f"{rel}:{ln} [{tag}] {line.strip()}")
+    assert not violations, (
+        "per-frame allocation idiom on the zero-copy hot path (use "
+        "wire_parts()/sendmsg, pooled recv_into, push_view — or add a "
+        "reviewed allowlist entry):\n  " + "\n  ".join(violations)
+    )
+    stale = [
+        (suf, sub)
+        for suf, sub in _HOT_ALLOWLIST
+        if not any(rel.endswith(suf) and sub in line for rel, line in matched_allow)
+    ]
+    assert not stale, f"allowlist entries no longer match anything (remove them): {stale}"

@@ -542,6 +542,25 @@ def main():
         "framework ceiling — see PERF_NOTES.md"
     )
 
+    # static cleanliness rides the bench trajectory alongside fps: the
+    # full lint registry (stdlib-only, <1 s, runs before jax-init so a
+    # wedged backend cannot mask it) lands finding counts BY CHECKER in
+    # the artifact — zeros mean "ran clean", an absent key means the
+    # lint run itself failed (recorded under lint.error)
+    try:
+        from psana_ray_tpu.lint import run_lint
+
+        _lint = run_lint()
+        extras["lint"] = {
+            "clean": _lint.ok,
+            "findings_total": len(_lint.findings),
+            "counts_by_checker": _lint.counts_by_checker(),
+            "files_scanned": _lint.files_scanned,
+            "duration_s": round(_lint.duration_s, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — lint must never kill the bench
+        extras["lint"] = {"error": repr(e)}
+
     from psana_ray_tpu.utils.hostmem import enable_large_alloc_reuse
 
     enable_large_alloc_reuse()

@@ -1,0 +1,90 @@
+"""CLI: ``python -m psana_ray_tpu.lint [--json] [paths...]``.
+
+Exit status is the CI contract: 0 = clean, 1 = findings (including
+allowlist rot), 2 = usage error. Runs the full registry over the
+package + bench.py by default, a subset with ``--checker`` (repeatable),
+or explicit files/directories given as positional paths.
+
+``--json`` emits the same shape the bench artifact embeds
+(``counts_by_checker`` includes zeros for every checker that ran, so
+"ran clean" and "did not run" stay distinguishable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from psana_ray_tpu.lint import REGISTRY, run_lint
+
+
+def _expand(paths):
+    out = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        else:
+            out.append(path)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m psana_ray_tpu.lint",
+        description="project-invariant static analysis (see README: "
+        "'Static analysis' runbook)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to scan (default: the package + bench.py)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--checker", action="append", metavar="NAME",
+        help="run only this checker (repeatable; see --list)",
+    )
+    ap.add_argument(
+        "--no-allowlist", action="store_true",
+        help="ignore the reviewed allowlist (show every raw finding)",
+    )
+    ap.add_argument("--list", action="store_true", help="list registered checkers")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(REGISTRY):
+            print(f"{name}: {REGISTRY[name].description}")
+        return 0
+    # a typo'd explicit path is a USAGE error (exit 2), never exit 1 —
+    # CI reads 1 as "findings present" and must not misread a typo as one
+    missing = [p for p in args.paths if not pathlib.Path(p).exists()]
+    if missing:
+        print(f"error: no such file or directory: {missing}", file=sys.stderr)
+        return 2
+    try:
+        result = run_lint(
+            paths=_expand(args.paths) if args.paths else None,
+            checkers=args.checker,
+            use_allowlist=not args.no_allowlist,
+        )
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        status = "clean" if result.ok else f"{len(result.findings)} finding(s)"
+        print(
+            f"lint: {status} — {result.files_scanned} files, "
+            f"{len(result.checkers_run)} checkers, {result.duration_s:.2f}s"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Reviewed exceptions to the project invariants — with justifications.
+
+Every entry excuses ONE (checker, file-suffix, line-substring) match and
+must carry a written ``why``. The framework turns unused entries into
+``allowlist-rot`` findings on full runs (generalizing the ``stale``
+assert the original ``tests/test_static.py`` hot-path screen shipped
+with): when the excused code changes or disappears, the entry fails the
+run until it is deleted — an allowlist that can only grow would
+eventually hide a real finding behind a dead excuse.
+
+Adding an entry is a REVIEW event, not an escape hatch: the ``why`` must
+say what bounds the excused behavior (a deadline, a byte count, a
+lifecycle contract), because that bound is exactly what the static
+checker could not see.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    checker: str  # checker name the entry excuses
+    file: str  # repo-relative path suffix
+    contains: str  # substring of the flagged source line
+    why: str  # REQUIRED written justification
+
+    def __post_init__(self):
+        if not self.why.strip():
+            raise ValueError(
+                f"allowlist entry for {self.file!r}/{self.contains!r} has no "
+                f"justification — every excuse must say why it is safe"
+            )
+
+
+ALLOWLIST = (
+    # -- hot-alloc: the reviewed, size-bounded uses migrated verbatim from
+    # the original tests/test_static.py _HOT_ALLOWLIST -------------------
+    Allow(
+        "hot-alloc", "transport/tcp.py", "return bytes(buf)",
+        why="_recv_exact materializes <=8-byte CONTROL fields (opcodes, "
+        "lengths); frame payloads go through _recv_into on a pooled lease",
+    ),
+    Allow(
+        "hot-alloc", "transport/codec.py", "return [TAG_RECORD + item.to_bytes()]",
+        why="EndOfStream wire form is header-only (tens of bytes), not a frame",
+    ),
+    Allow(
+        "hot-alloc", "transport/codec.py", "return TAG_RECORD + item.to_bytes()",
+        why="legacy contiguous encode_payload kept for back-compat callers "
+        "OFF the hot path; the hot path uses encode_payload_parts",
+    ),
+    Allow(
+        "hot-alloc", "transport/codec.py", "tag = bytes(buf[:1])",
+        why="1-byte tag peek; copying a single byte is not a frame-sized alloc",
+    ),
+    Allow(
+        "hot-alloc", "transport/shm_ring.py", "if bytes(mv[:1]) == _TAG_VOID:",
+        why="1-byte void-marker peek on the slot view",
+    ),
+    Allow(
+        "hot-alloc", "records.py", "return header + payload.tobytes()",
+        why="legacy FrameRecord.to_bytes kept for back-compat callers off "
+        "the hot path; wire_parts() is the zero-copy replacement",
+    ),
+    Allow(
+        "hot-alloc", "records.py", "data = item.to_bytes()  # header-only, tiny",
+        why="encode_into EOS arm: header-only marker, tens of bytes",
+    ),
+    # -- thread-hygiene ---------------------------------------------------
+    Allow(
+        "thread-hygiene", "psana_ray_tpu/producer.py",
+        "threading.Thread(target=self._pump",
+        why="foreground shard pumps: run(block=True)/join() block on them "
+        "by CONTRACT and each pump exits at EOS or stop(); deliberately "
+        "non-daemon so an early main-thread exit cannot kill in-flight "
+        "shard streaming mid-frame (the CLI's whole job is those pumps)",
+    ),
+    # -- blocking-hot-path: deadline-bounded poll backoffs the static
+    # call-graph cannot prove bounded ------------------------------------
+    Allow(
+        "blocking-hot-path", "infeed/batcher.py",
+        "time.sleep(max(poll_interval_s, 0.02))",
+        why="the PR 2 competing-consumer livelock fix: a deliberate "
+        "scheduler yield after returning sibling EOS markers, taken only "
+        "when starved, bounded by poll_interval — removing it re-opens "
+        "the 60+ s EOS livelock (EosTally.flush_duplicates docstring)",
+    ),
+    Allow(
+        "blocking-hot-path", "transport/shm_ring.py", "time.sleep(0.0002)",
+        why="_get_batch first-item poll: the caller's timeout deadline is "
+        "re-checked before every sleep, so total blocking is caller-bounded",
+    ),
+    Allow(
+        "blocking-hot-path", "transport/shm_ring.py", "time.sleep(poll_s)",
+        why="put_wait/get_wait poll backoff: deadline-checked every "
+        "iteration; poll_s and timeout are caller-supplied bounds",
+    ),
+)

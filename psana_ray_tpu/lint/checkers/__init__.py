@@ -1,0 +1,19 @@
+"""Checker modules — importing this package registers every checker.
+
+One module per invariant; each names the bug (from this repo's own PR
+history) it exists to prevent. Add a new checker by dropping a module
+here that subclasses :class:`psana_ray_tpu.lint.core.Checker` and
+decorates it with ``@register``, then giving it a bad/good fixture pair
+under ``tests/lint_fixtures/`` (the tier-1 driver enforces that every
+registered checker has one).
+"""
+
+from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
+    blocking,
+    hotalloc,
+    leases,
+    locks,
+    names,
+    threads,
+    wire,
+)

@@ -1,0 +1,92 @@
+"""wire-protocol: every opcode has both a sender and a dispatch arm.
+
+The TCP wire protocol (``transport/tcp.py``) is a hand-rolled opcode
+dispatch: the client sends 1-byte opcodes, ``TcpQueueServer._serve_conn``
+matches them in an if/elif chain. Nothing but convention keeps the two
+sides in sync — a new opcode wired into the client but not the server
+is a protocol error AT RUNTIME on the first use (the server answers
+``E`` and drops the connection), and a dispatch arm nobody sends is
+dead protocol surface that still has to be security-reviewed.
+
+The checker is structural, not name-bound to tcp.py: any scanned module
+that defines module-level ``_OP_*``/``OP_*`` byte constants gets the
+exhaustiveness rule —
+
+- **dispatch side**: the opcode appears in an equality comparison
+  (``op == _OP_PUT`` — the server's if/elif chain);
+- **send side**: the opcode is referenced anywhere else (request
+  assembly, ``sendall``/``sendmsg`` arguments).
+
+Every opcode must appear on BOTH sides; one defined but used on neither
+is dead protocol. Status bytes (``_ST_*``) are deliberately out of
+scope: they are response payloads, not dispatch keys.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from psana_ray_tpu.lint.core import Checker, Finding, register
+
+OP_NAME = re.compile(r"^_?OP_[A-Z0-9_]+$")
+
+
+@register
+class WireProtocolChecker(Checker):
+    name = "wire-protocol"
+    description = (
+        "every _OP_* opcode constant must be both sent by client code and "
+        "matched in a dispatch comparison (and vice versa)"
+    )
+
+    def run(self, index):
+        for fi in index.files:
+            ops = {}  # name -> defining line
+            for node in fi.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and OP_NAME.match(node.targets[0].id)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, bytes)
+                ):
+                    ops[node.targets[0].id] = node.lineno
+            if not ops:
+                continue
+            dispatched, sent = {}, {}  # name -> first line seen
+            for node in ast.walk(fi.tree):
+                if not (isinstance(node, ast.Name) and node.id in ops):
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    continue  # the definition itself
+                in_compare = any(
+                    isinstance(anc, ast.Compare) for anc in fi.ancestors(node)
+                )
+                side = dispatched if in_compare else sent
+                side.setdefault(node.id, node.lineno)
+            for op, lineno in sorted(ops.items()):
+                if op in sent and op not in dispatched:
+                    yield Finding(
+                        checker=self.name, path=fi.rel, line=sent[op],
+                        message=f"opcode {op} is sent but never matched in "
+                        f"any dispatch comparison — the peer will answer "
+                        f"protocol-error and drop the connection",
+                        hint=f"add an `op == {op}` arm to the serve loop",
+                    )
+                elif op in dispatched and op not in sent:
+                    yield Finding(
+                        checker=self.name, path=fi.rel, line=dispatched[op],
+                        message=f"opcode {op} has a dispatch arm but no code "
+                        f"ever sends it — dead protocol surface",
+                        hint=f"wire a sender for {op} or delete the arm and "
+                        f"the constant",
+                    )
+                elif op not in sent and op not in dispatched:
+                    yield Finding(
+                        checker=self.name, path=fi.rel, line=lineno,
+                        message=f"opcode {op} is defined but never sent nor "
+                        f"dispatched",
+                        hint="delete the constant or wire both sides",
+                    )

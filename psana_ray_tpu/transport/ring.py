@@ -53,19 +53,21 @@ class RingBuffer:
             raise ValueError("maxsize must be positive")
         self.maxsize = int(maxsize)
         self.name = name
-        self._q: deque = deque()
+        self._q: deque = deque()  # guarded-by: _lock
         self._lock = threading.Lock()
+        # Condition(self._lock): holding either condition IS holding _lock
+        # (the lint lock-discipline checker understands the aliasing)
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
-        self._closed = False
-        self._draining = False
+        self._closed = False  # guarded-by: _lock
+        self._draining = False  # guarded-by: _lock
         # lifetime counters (observability the reference lacks, SURVEY.md §5)
-        self._n_put = 0
-        self._n_get = 0
-        self._n_put_rejected = 0
-        self._high_water = 0
-        self._last_put_t: float = -1.0  # monotonic; -1 = never
-        self._last_get_t: float = -1.0
+        self._n_put = 0  # guarded-by: _lock
+        self._n_get = 0  # guarded-by: _lock
+        self._n_put_rejected = 0  # guarded-by: _lock
+        self._high_water = 0  # guarded-by: _lock
+        self._last_put_t: float = -1.0  # monotonic; -1 = never  # guarded-by: _lock
+        self._last_get_t: float = -1.0  # guarded-by: _lock
 
     # -- reference-parity non-blocking surface ---------------------------
     def put(self, item: Any) -> bool:
@@ -180,19 +182,22 @@ class RingBuffer:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def _check_open(self):
+        # guarded-by-caller: _lock
         if self._closed:
             raise TransportClosed(f"queue {self.name!r} is closed")
 
     def _check_accepting(self):
+        # guarded-by-caller: _lock
         if self._draining:
             raise TransportClosed(f"queue {self.name!r} is draining (shutdown)")
 
     # -- observability ---------------------------------------------------
     def _note_put(self):
-        # caller holds self._lock
+        # guarded-by-caller: _lock
         self._n_put += 1
         depth = len(self._q)
         if depth > self._high_water:
@@ -200,7 +205,7 @@ class RingBuffer:
         self._last_put_t = time.monotonic()
 
     def _note_get(self, n: int = 1):
-        # caller holds self._lock
+        # guarded-by-caller: _lock
         self._n_get += n
         self._last_get_t = time.monotonic()
 

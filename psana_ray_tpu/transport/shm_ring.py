@@ -194,20 +194,24 @@ class ShmRingBuffer:
     DEFAULT_SLOT_BYTES = 9 * 1024 * 1024
 
     def __init__(self, handle, name: str, owner: bool):
-        self._h = handle
+        self._h = handle  # guarded-by: _handle_lock
         self.name = name
         self._owner = owner
         self._lib = _load_lib()
         # immutable after creation; cached so put()/put_wait spins skip
         # the FFI round trip
         self._slot_bytes = int(self._lib.shmring_slot_bytes(handle))
-        self._voids_skipped = 0
-        self._slot_leases = 0  # outstanding zero-copy gets (see _SlotLease)
-        # serializes the read surface (stats/size — scraped from metrics
-        # HTTP threads) against disconnect()/destroy() freeing the C
-        # handle: a check-then-use on _h alone can still pass a freed
-        # pointer to C when the scrape races teardown. REENTRANT because
-        # a _SlotLease can release from __del__ — cyclic GC may run it on
+        self._voids_skipped = 0  # guarded-by: _handle_lock
+        # outstanding zero-copy gets (see _SlotLease)
+        self._slot_leases = 0  # guarded-by: _handle_lock
+        # serializes EVERY use of the C handle — the read surface
+        # (stats/size — scraped from metrics HTTP threads), the data ops
+        # (put/get: held across the FFI call, so disconnect() can never
+        # free the handle mid-memcpy), and teardown itself — against
+        # disconnect()/destroy() freeing it: a check-then-use on _h alone
+        # can still pass a freed pointer to C when any of them races
+        # teardown (the PR 1 segfault class). REENTRANT because a
+        # _SlotLease can release from __del__ — cyclic GC may run it on
         # the very thread that already holds this lock
         self._handle_lock = threading.RLock()
 
@@ -215,7 +219,8 @@ class ShmRingBuffer:
         """Wedge-detection window for THIS handle (0 disables): a slot
         claimed by a peer but left uncommitted/unreleased longer than this
         raises :class:`TransportWedged` instead of stalling forever."""
-        self._lib.shmring_set_stall_timeout(self._h, int(seconds * 1000))
+        with self._handle_lock:
+            self._lib.shmring_set_stall_timeout(self._live_handle(), int(seconds * 1000))
 
     def _wedged_msg(self, peer: str, verb: str) -> str:
         return (
@@ -276,29 +281,37 @@ class ShmRingBuffer:
             raise ValueError(f"message of {n} bytes exceeds slot size {slot_bytes}")
         ptr = ctypes.c_void_p()
         ticket = ctypes.c_uint64()
-        rc = self._lib.shmring_reserve(self._h, ctypes.byref(ptr), ctypes.byref(ticket))
-        if rc == 0:
-            return False
-        if rc == -2:
-            raise TransportClosed(f"shm ring {self.name!r} is closed")
-        if rc == -4:
-            raise TransportWedged(self._wedged_msg("consumer", "released"))
-        mv = memoryview((ctypes.c_ubyte * slot_bytes).from_address(ptr.value)).cast("B")
-        ok = False
-        try:
-            if wire:
-                mv[0:1] = _TAG_RECORD
-                encode_into(item, mv[1:n])
-            else:
-                mv[:n] = payload
-            ok = True
-        finally:
-            # always publish the claimed slot — an unreleased claim would
-            # wedge every consumer at this position forever. A failed
-            # encode publishes a 1-byte void marker consumers skip.
-            if not ok:
-                mv[0:1] = _TAG_VOID
-            self._lib.shmring_commit(self._h, ticket, n if ok else 1)
+        # the lock is held across reserve -> encode -> commit: disconnect/
+        # destroy must not munmap the slot while the memcpy into it runs
+        # (reserve and commit are non-blocking C calls, and in-process
+        # producers sharing one handle were already serialized by the GIL
+        # around the FFI boundary, so this costs no real concurrency)
+        with self._handle_lock:
+            h = self._live_handle()
+            rc = self._lib.shmring_reserve(h, ctypes.byref(ptr), ctypes.byref(ticket))
+            if rc == 0:
+                return False
+            if rc == -2:
+                raise TransportClosed(f"shm ring {self.name!r} is closed")
+            if rc == -4:
+                raise TransportWedged(self._wedged_msg("consumer", "released"))
+            mv = memoryview((ctypes.c_ubyte * slot_bytes).from_address(ptr.value)).cast("B")
+            ok = False
+            try:
+                if wire:
+                    mv[0:1] = _TAG_RECORD
+                    encode_into(item, mv[1:n])
+                else:
+                    mv[:n] = payload
+                ok = True
+            finally:
+                # always publish the claimed slot — an unreleased claim
+                # would wedge every consumer at this position forever. A
+                # failed encode publishes a 1-byte void marker consumers
+                # skip.
+                if not ok:
+                    mv[0:1] = _TAG_VOID
+                self._lib.shmring_commit(h, ticket, n if ok else 1)
         return True
 
     def get(self) -> Any:
@@ -322,31 +335,37 @@ class ShmRingBuffer:
         while True:
             ptr = ctypes.c_void_p()
             ticket = ctypes.c_uint64()
-            n = self._lib.shmring_acquire(self._h, ctypes.byref(ptr), ctypes.byref(ticket))
-            if n == -1:
-                return EMPTY
-            if n == -2:
-                raise TransportClosed(f"shm ring {self.name!r} is closed")
-            if n == -4:
-                raise TransportWedged(self._wedged_msg("producer", "committed"))
-            mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
-            if bytes(mv[:1]) == _TAG_VOID:
-                self._voids_skipped += 1
-                self._lib.shmring_release(self._h, ticket)
-                continue
-            if not view:
-                try:
-                    return self._decode(mv)  # copies panels out of the slot
-                finally:
-                    self._lib.shmring_release(self._h, ticket)
+            # held across acquire -> decode -> release: teardown must not
+            # munmap the slot while the decode copy (or the zero-copy view
+            # hand-off) reads it — the same UAF class as the PR 1 scrape
+            # segfault, on the data path. RLock: _SlotLease.release (e.g.
+            # via GC inside decode's allocations) re-enters safely.
             with self._handle_lock:
+                h = self._live_handle()
+                n = self._lib.shmring_acquire(h, ctypes.byref(ptr), ctypes.byref(ticket))
+                if n == -1:
+                    return EMPTY
+                if n == -2:
+                    raise TransportClosed(f"shm ring {self.name!r} is closed")
+                if n == -4:
+                    raise TransportWedged(self._wedged_msg("producer", "committed"))
+                mv = memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value)).cast("B")
+                if bytes(mv[:1]) == _TAG_VOID:
+                    self._voids_skipped += 1
+                    self._lib.shmring_release(h, ticket)
+                    continue
+                if not view:
+                    try:
+                        return self._decode(mv)  # copies panels out of the slot
+                    finally:
+                        self._lib.shmring_release(h, ticket)
                 self._slot_leases += 1
-            lease = _SlotLease(self, int(ticket.value))
-            try:
-                return decode_payload(mv, lease=lease)
-            except BaseException:
-                lease.release()
-                raise
+                lease = _SlotLease(self, int(ticket.value))
+                try:
+                    return decode_payload(mv, lease=lease)
+                except BaseException:
+                    lease.release()
+                    raise
 
     def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.0002) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -398,9 +417,10 @@ class ShmRingBuffer:
 
     def _live_handle(self):
         """The C handle, or TransportClosed after disconnect()/destroy().
-        The observability surfaces (stats/size — scraped by metrics
-        endpoints, possibly after teardown) must fail as a catchable
+        Every surface that hands the handle to C (data ops, stats/size
+        scrapes — possibly after teardown) must fail as a catchable
         dead-transport error, never hand NULL to C (a segfault)."""
+        # guarded-by-caller: _handle_lock
         h = self._h
         if not h:
             raise TransportClosed(f"shm ring {self.name!r} is detached")
@@ -444,13 +464,14 @@ class ShmRingBuffer:
             h = self._live_handle()
             self._lib.shmring_stats(h, ctypes.byref(buf))
             maxsize = int(self._lib.shmring_capacity(h))
+            voids = self._voids_skipped
         return {
             "depth": int(buf[0]),
             "maxsize": maxsize,
             "puts": int(buf[1]),
             "gets": int(buf[2]),
             "puts_rejected": int(buf[3]),
-            "voids_skipped": self._voids_skipped,
+            "voids_skipped": voids,
         }
 
     def disconnect(self):
@@ -470,7 +491,7 @@ class ShmRingBuffer:
                 self._h = None
 
     def _warn_live_leases(self, what: str):
-        # caller holds _handle_lock. Unmapping under a zero-copy record's
+        # guarded-by-caller: _handle_lock. Unmapping under a zero-copy record's
         # panels view is use-after-munmap; surface it loudly — the fix is
         # to release (push_view/materialize) before teardown.
         if self._h and self._slot_leases > 0:

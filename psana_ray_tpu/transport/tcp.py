@@ -272,7 +272,7 @@ class TcpQueueServer:
         self._queue_factory = queue_factory or (
             lambda ns, name, maxsize: RingBuffer(maxsize, name=f"{ns}__{name}")
         )
-        self._queues = {}  # (namespace, name) -> queue
+        self._queues = {}  # (namespace, name) -> queue  # guarded-by: _queues_lock
         self._queues_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -282,7 +282,7 @@ class TcpQueueServer:
         self._stop = threading.Event()
         self._draining = False
         self._threads: List[threading.Thread] = []
-        self._conns: List[socket.socket] = []
+        self._conns: List[socket.socket] = []  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):

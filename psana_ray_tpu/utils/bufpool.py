@@ -105,7 +105,7 @@ class BufferPool:
     lock.
     """
 
-    _default: Optional["BufferPool"] = None
+    _default: Optional["BufferPool"] = None  # guarded-by: _default_lock
     _default_lock = threading.Lock()
 
     def __init__(self, min_per_class: int = 4, debug: Optional[bool] = None):
@@ -114,19 +114,19 @@ class BufferPool:
             debug = os.environ.get("PSANA_RAY_BUFPOOL_DEBUG", "") not in ("", "0")
         self.debug = debug
         self._lock = threading.Lock()
-        self._free: Dict[int, List[bytearray]] = {}
-        self._out_by_class: Dict[int, int] = {}  # currently leased
-        self._peak_by_class: Dict[int, int] = {}  # high-water leased
-        self._rel_by_class: Dict[int, int] = {}  # releases since last decay
-        self._hits = 0
-        self._misses = 0
+        self._free: Dict[int, List[bytearray]] = {}  # guarded-by: _lock
+        self._out_by_class: Dict[int, int] = {}  # currently leased  # guarded-by: _lock
+        self._peak_by_class: Dict[int, int] = {}  # high-water leased  # guarded-by: _lock
+        self._rel_by_class: Dict[int, int] = {}  # releases since last decay  # guarded-by: _lock
+        self._hits = 0  # guarded-by: _lock
+        self._misses = 0  # guarded-by: _lock
         # misses while the class was ALREADY at this concurrency before
         # (the pool could have retained a buffer but didn't) — the
         # steady-state allocation churn, as opposed to working-set growth
-        self._churn_misses = 0
-        self._leases = 0  # currently outstanding
-        self._bytes_pooled = 0  # resident in free lists
-        self._outstanding: Dict[int, str] = {}  # id(lease) -> stack (debug)
+        self._churn_misses = 0  # guarded-by: _lock
+        self._leases = 0  # currently outstanding  # guarded-by: _lock
+        self._bytes_pooled = 0  # resident in free lists  # guarded-by: _lock
+        self._outstanding: Dict[int, str] = {}  # id(lease) -> stack (debug)  # guarded-by: _lock
 
     @classmethod
     def default(cls) -> "BufferPool":
@@ -243,8 +243,8 @@ class WireCounters:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.bytes_copied = 0
-        self.copies = 0
+        self.bytes_copied = 0  # guarded-by: _lock
+        self.copies = 0  # guarded-by: _lock
 
     def add(self, nbytes: int):
         with self._lock:

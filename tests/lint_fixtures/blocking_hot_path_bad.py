@@ -1,0 +1,39 @@
+"""BAD: unbounded waits reachable from the drain loop — a sleep two
+calls deep, a bare lock acquire, and an unbounded join, all under a
+function the call graph roots at."""
+
+import time
+from time import sleep as _zzz
+
+
+def _nap():
+    _zzz(0.25)  # bare-name sleep: same stall as time.sleep
+
+
+def _settle(lock):
+    lock.acquire()  # no timeout: a stuck peer stalls the drain forever
+    try:
+        time.sleep(0.5)
+    finally:
+        lock.release()
+
+
+def _settle_explicit(lock):
+    # acquire(True) is the SAME unbounded wait — the first positional is
+    # `blocking`, not a timeout, and must not be mistaken for a bound
+    lock.acquire(True)
+    lock.release()
+
+
+def _flush_leg(thread):
+    thread.join()  # unbounded
+
+
+def batches_from_queue(queue, lock, thread):
+    while True:
+        _settle(lock)
+        _settle_explicit(lock)
+        _nap()
+        _flush_leg(thread)
+        if queue.empty():
+            return

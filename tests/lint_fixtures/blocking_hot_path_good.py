@@ -1,0 +1,31 @@
+"""GOOD: every wait under the drain loop carries a deadline — timed
+acquire, timed join, timeout-bearing queue ops, and `with lock:`
+micro-sections (not flagged: the timeout-expressible explicit-wait form
+is the banned one)."""
+
+
+def _settle(lock):
+    if not lock.acquire(timeout=1.0):
+        raise TimeoutError("lock held past deadline")
+    try:
+        pass
+    finally:
+        lock.release()
+
+
+def _flush_leg(thread):
+    thread.join(2.0)
+
+
+def _account(lock, counters):
+    with lock:
+        counters["batches"] += 1
+
+
+def batches_from_queue(queue, lock, thread, counters):
+    while True:
+        _settle(lock)
+        _flush_leg(thread)
+        _account(lock, counters)
+        if not queue.get(timeout=0.05):
+            return

@@ -1,0 +1,29 @@
+# lint: hot-path
+"""BAD: the pre-ISSUE-2 datapath idioms — a frame-sized serialization
+copy, contiguous request assembly, a fresh bytes object per recv chunk,
+and a bytes(...) materialization of a buffer."""
+
+
+def send_frame(sock, rec):
+    payload = rec.panels.tobytes()
+    sock.sendall(rec.to_bytes())
+    return payload
+
+
+def read_exact(sock, n):
+    chunks = []
+    while n:
+        c = sock.recv(n)
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def snapshot(mv):
+    return bytes(mv)
+
+
+def framed(sep, arr):
+    # a '#' inside a string literal must not hide the banned call behind
+    # naive comment stripping
+    return sep.join([b"#", arr.tobytes()])

@@ -1,0 +1,18 @@
+# lint: hot-path
+"""GOOD: the sanctioned zero-copy shapes — scatter-gather parts out,
+recv_into a pooled lease in, and size-derived bookkeeping (nbytes,
+from_bytes) that the banned-idiom lookbehind must not misread."""
+
+
+def send_frame(sock, rec):
+    sock.sendmsg(rec.wire_parts())
+
+
+def read_payload(sock, mv):
+    got = 0
+    while got < len(mv):
+        got += sock.recv_into(mv[got:])
+
+
+def sizes(rec):
+    return rec.nbytes(), len(rec.from_bytes(b""))

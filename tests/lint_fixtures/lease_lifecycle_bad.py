@@ -1,0 +1,21 @@
+"""BAD: leases that only the GC backstop would ever free — an assigned
+lease with no release on any path, a dropped result, and a batch-view
+drain that never routes records to an owner."""
+
+
+def recv_one(pool, sock, n):
+    lease = pool.lease(n)
+    sock.recv_into(lease.mv)
+    return n  # lease stranded: returned value does not carry it
+
+
+def peek(queue):
+    queue.get_view()  # result dropped on the floor
+
+
+def drain(queue):
+    total = 0
+    items = queue.get_batch_view(32)
+    for rec in items:
+        total += rec.event_idx  # never released / materialized / pushed
+    return total

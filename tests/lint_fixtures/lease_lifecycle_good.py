@@ -1,0 +1,29 @@
+"""GOOD: the sanctioned ownership patterns — exception-path release with
+the lease riding the decoded record, context-manager scope, ownership
+transfer by return, and a batch drain that pushes every record through
+the owner that copies-then-releases."""
+
+
+def recv_one(pool, sock, n, decode_payload):
+    lease = pool.lease(n)
+    try:
+        sock.recv_into(lease.mv)
+        return decode_payload(lease.mv, lease=lease)
+    except BaseException:
+        lease.release()
+        raise
+
+
+def scratch(pool, n):
+    with pool.lease(n) as lease:
+        return len(lease.mv)
+
+
+def handoff(pool, n):
+    return pool.lease(n)  # caller owns it (checked at ITS call site)
+
+
+def drain(queue, batcher):
+    items = queue.get_batch_view(32)
+    for rec in items:
+        batcher.push_view(rec)  # copies into the arena, then releases

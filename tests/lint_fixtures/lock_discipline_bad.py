@@ -1,0 +1,24 @@
+"""BAD: the PR 1 scrape-vs-teardown shape — `_handle` is declared
+guarded but the stats read and the teardown write both touch it without
+the lock (check-then-use passes a freed handle to C)."""
+
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._handle_lock = threading.Lock()
+        self._handle = object()  # guarded-by: _handle_lock
+        # tuple targets must not silently drop the annotation
+        self._gets, self._puts = 0, 0  # guarded-by: _handle_lock
+
+    def bump(self):
+        self._gets += 1  # unlocked counter write
+
+    def stats(self):
+        if self._handle is None:
+            raise RuntimeError("detached")
+        return id(self._handle)
+
+    def disconnect(self):
+        self._handle = None

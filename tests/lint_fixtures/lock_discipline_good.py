@@ -1,0 +1,36 @@
+"""GOOD: every touch of the guarded attribute holds the lock — directly,
+through a Condition constructed over it (aliasing), or inside a helper
+whose callers provably hold it (`guarded-by-caller`)."""
+
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._handle_lock = threading.Lock()
+        self._cv = threading.Condition(self._handle_lock)
+        self._handle = object()  # guarded-by: _handle_lock
+        self._gets, self._puts = 0, 0  # guarded-by: _handle_lock
+
+    def bump(self):
+        with self._handle_lock:
+            self._gets += 1
+            self._puts += 1
+
+    def stats(self):
+        with self._handle_lock:
+            return id(self._live())
+
+    def wait_attached(self):
+        with self._cv:  # Condition over _handle_lock: counts as held
+            return self._handle is not None
+
+    def _live(self):
+        # guarded-by-caller: _handle_lock
+        if self._handle is None:
+            raise RuntimeError("detached")
+        return self._handle
+
+    def disconnect(self):
+        with self._handle_lock:
+            self._handle = None

@@ -1,0 +1,15 @@
+"""BAD: the PR 1 pytest-exit hang shape — a non-daemon worker in a
+module whose only join is unbounded (the hang just moves from
+interpreter exit to the join site)."""
+
+import threading
+
+
+def start_worker(target):
+    t = threading.Thread(target=target, name="worker")
+    t.start()
+    return t
+
+
+def stop_worker(t):
+    t.join()  # unbounded: a wedged target hangs shutdown forever

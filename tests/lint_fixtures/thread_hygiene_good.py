@@ -1,0 +1,22 @@
+"""GOOD: both sanctioned shapes — a daemon thread the process may exit
+without, and a non-daemon worker whose module joins with a deadline."""
+
+import threading
+
+
+def start_sidecar(target):
+    t = threading.Thread(target=target, daemon=True, name="sidecar")
+    t.start()
+    return t
+
+
+def start_worker(target):
+    t = threading.Thread(target=target, name="worker")
+    t.start()
+    return t
+
+
+def stop_worker(t):
+    t.join(timeout=5.0)
+    if t.is_alive():
+        raise RuntimeError("worker did not stop in 5s")

@@ -1,0 +1,12 @@
+"""BAD: `List` is loaded but never imported — the seed's utils/metrics.py
+bug shape (`from __future__ import annotations` hides it at runtime
+until someone introspects the annotations)."""
+
+from __future__ import annotations
+
+
+def quantiles(samples) -> List[float]:
+    return list(sorted(samples))
+
+
+LEVELS: List[float] = [0.5, 0.9, 0.99]

@@ -1,0 +1,12 @@
+"""GOOD: the same module with the import present."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def quantiles(samples) -> List[float]:
+    return list(sorted(samples))
+
+
+LEVELS: List[float] = [0.5, 0.9, 0.99]

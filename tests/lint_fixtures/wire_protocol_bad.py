@@ -1,0 +1,27 @@
+"""BAD: three asymmetric opcodes — sent with no dispatch arm (runtime
+protocol error on first use), dispatched with no sender (dead surface),
+and defined on neither side."""
+
+_OP_PUT = b"P"
+_OP_GET = b"G"
+_OP_FLUSH = b"L"  # sent below, never dispatched
+_OP_LEGACY = b"Y"  # dispatched below, never sent
+_OP_GHOST = b"Z"  # defined, used nowhere
+
+
+def request(sock, payload):
+    sock.sendall(_OP_PUT + payload)
+    sock.sendall(_OP_FLUSH)
+
+
+def poll(sock):
+    sock.sendall(_OP_GET)
+
+
+def serve(op, queue):
+    if op == _OP_PUT:
+        return queue.put
+    elif op == _OP_GET:
+        return queue.get
+    elif op == _OP_LEGACY:
+        return queue.size

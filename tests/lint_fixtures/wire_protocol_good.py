@@ -1,0 +1,19 @@
+"""GOOD: every opcode has both a sender and a dispatch arm."""
+
+_OP_PUT = b"P"
+_OP_GET = b"G"
+
+
+def request(sock, payload):
+    sock.sendall(_OP_PUT + payload)
+
+
+def poll(sock):
+    sock.sendall(_OP_GET)
+
+
+def serve(op, queue):
+    if op == _OP_PUT:
+        return queue.put
+    elif op == _OP_GET:
+        return queue.get

@@ -1,239 +1,34 @@
-"""Static NameError screen over the package (satellite of ISSUE 1).
+"""Back-compat anchors for the two original static screens (ISSUE 1/2).
 
-The seed shipped ``List[float]`` in utils/metrics.py with ``List`` never
-imported — invisible to the suite because ``from __future__ import
-annotations`` defers evaluation, but a latent NameError for any consumer
-that introspects the annotations. This test makes that class of bug a
-tier-1 failure: pyflakes when the environment has it, else a conservative
-stdlib AST checker that flags loads of names never bound anywhere in the
-module (no false positives by construction: any binding anywhere in the
-file — any scope — whitelists the name).
-
-Fast (< 1 s for the whole package) and dependency-free, so it is always
-``-m 'not slow'``-eligible.
+The NameError scan and the hot-path allocation-idiom screen that used to
+live here as ad-hoc test code are now first-class checkers in
+:mod:`psana_ray_tpu.lint` (ISSUE 3) — registry, shared parse, central
+allowlist with rot detection, CLI. ``tests/test_lint.py`` is the full
+tier-1 driver; these two tests pin the MIGRATED screens by name so the
+original invariants keep their own failure identity (a hot-path
+regression fails here exactly as it did pre-framework, not just inside
+an aggregate lint test).
 """
 
-import ast
-import builtins
-import pathlib
+from __future__ import annotations
 
-import pytest
-
-PACKAGE_ROOT = pathlib.Path(__file__).resolve().parent.parent
-SOURCES = sorted((PACKAGE_ROOT / "psana_ray_tpu").rglob("*.py")) + [
-    PACKAGE_ROOT / "bench.py"
-]
-
-# Module-level / implicit names that are defined without an AST binding.
-_IMPLICIT = {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__annotations__",
-    "__class__", "__path__", "__qualname__", "__module__", "__dict__",
-}
-_ALLOWED = set(dir(builtins)) | _IMPLICIT
+from psana_ray_tpu.lint import run_lint
 
 
-class _Binder(ast.NodeVisitor):
-    """Collect every name the module binds, in ANY scope (conservative:
-    scope-blind union, so cross-scope uses never false-positive)."""
-
-    def __init__(self):
-        self.bound = set()
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, (ast.Store, ast.Del)):
-            self.bound.add(node.id)
-        self.generic_visit(node)
-
-    def _bind_args(self, args: ast.arguments):
-        for a in (
-            *args.posonlyargs, *args.args, *args.kwonlyargs,
-            *filter(None, (args.vararg, args.kwarg)),
-        ):
-            self.bound.add(a.arg)
-
-    def visit_FunctionDef(self, node):
-        self.bound.add(node.name)
-        self._bind_args(node.args)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node):
-        self.bound.add(node.name)
-        self._bind_args(node.args)
-        self.generic_visit(node)
-
-    def visit_Lambda(self, node):
-        self._bind_args(node.args)
-        self.generic_visit(node)
-
-    def visit_ClassDef(self, node):
-        self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_Import(self, node):
-        for alias in node.names:
-            self.bound.add(alias.asname or alias.name.split(".")[0])
-
-    def visit_ImportFrom(self, node):
-        for alias in node.names:
-            if alias.name != "*":
-                self.bound.add(alias.asname or alias.name)
-
-    def visit_ExceptHandler(self, node):
-        if node.name:
-            self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_Global(self, node):
-        self.bound.update(node.names)
-
-    def visit_Nonlocal(self, node):
-        self.bound.update(node.names)
-
-    def visit_MatchAs(self, node):
-        if node.name:
-            self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_MatchStar(self, node):
-        if node.name:
-            self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_MatchMapping(self, node):
-        if node.rest:
-            self.bound.add(node.rest)
-        self.generic_visit(node)
+def _findings(checker: str):
+    result = run_lint(checkers=[checker])
+    return [f for f in result.findings if f.checker == checker]
 
 
-def undefined_names(tree: ast.AST):
-    """``[(lineno, name), ...]`` loads of names never bound in the file."""
-    binder = _Binder()
-    binder.visit(tree)
-    known = binder.bound | _ALLOWED
-    out = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Name)
-            and isinstance(node.ctx, ast.Load)
-            and node.id not in known
-        ):
-            out.append((node.lineno, node.id))
-    return out
-
-
-def _pyflakes_messages(path):
-    """Real pyflakes when available (richer: unused imports stay advisory,
-    undefined names fail); None when the environment lacks it."""
-    try:
-        from pyflakes import api as pyflakes_api
-        from pyflakes import reporter as pyflakes_reporter
-    except ImportError:
-        return None
-    import io
-
-    buf = io.StringIO()
-    rep = pyflakes_reporter.Reporter(buf, buf)
-    pyflakes_api.checkPath(str(path), reporter=rep)
-    return [
-        line
-        for line in buf.getvalue().splitlines()
-        # fail only on NameError-class findings; style findings (unused
-        # import, redefinition) stay out of tier-1
-        if "undefined name" in line or "local variable" in line and "referenced before" in line
-    ]
-
-
-@pytest.mark.parametrize("path", SOURCES, ids=lambda p: str(p.relative_to(PACKAGE_ROOT)))
-def test_no_undefined_names(path):
-    src = path.read_text()
-    tree = ast.parse(src, filename=str(path))  # syntax is checked for free
-    flakes = _pyflakes_messages(path)
-    if flakes is not None:
-        assert not flakes, "pyflakes: " + "; ".join(flakes)
-        return
-    missing = undefined_names(tree)
-    assert not missing, (
-        f"{path.name}: names used but never bound (latent NameError): "
-        + ", ".join(f"line {ln}: {name}" for ln, name in missing)
-    )
-
-
-# ---------------------------------------------------------------------------
-# Zero-copy invariant screen (ISSUE 2 satellite): the transport/infeed hot
-# path must not regrow per-frame allocation idioms. Every frame payload
-# travels as (a) a wire_parts() memoryview out via sendmsg, (b) a pooled
-# recv_into lease in, (c) ONE np.copyto into the batch arena — so
-# `.tobytes()` (frame-sized serialization copy), `.to_bytes(` calls
-# (contiguous assembly), raw `.recv(` (fresh bytes per chunk) and
-# frame-scale `bytes(...)` materialization are BANNED in these files,
-# except for the reviewed, size-bounded uses below.
-
-import re  # noqa: E402
-
-HOT_PATH_FILES = [
-    "psana_ray_tpu/records.py",
-    "psana_ray_tpu/transport/codec.py",
-    "psana_ray_tpu/transport/tcp.py",
-    "psana_ray_tpu/transport/shm_ring.py",
-    "psana_ray_tpu/infeed/batcher.py",
-]
-
-_BANNED = [
-    # frame-sized ndarray -> bytes serialization copy
-    ("tobytes", re.compile(r"\.tobytes\(")),
-    # record -> contiguous bytes assembly (wire_parts exists instead)
-    ("to_bytes-call", re.compile(r"\.to_bytes\(")),
-    # chunked recv(): a fresh bytes object per chunk; use _recv_into on
-    # a pooled buffer (recv_into is fine and not matched)
-    ("raw-recv", re.compile(r"\.recv\(")),
-    # bytes(...) materialization of a buffer (lookbehind skips nbytes(,
-    # from_bytes(, slot_bytes( etc.)
-    ("bytes-materialize", re.compile(r"(?<![A-Za-z0-9_.])bytes\(")),
-]
-
-# (file suffix, line substring) — each entry is a REVIEWED exception:
-# control-plane reads of a few bytes, 1-byte tag peeks, or the legacy
-# contiguous encoders that back-compat callers still use off the hot
-# path. An entry that stops matching fails the test too (allowlist rot).
-_HOT_ALLOWLIST = [
-    ("transport/tcp.py", "return bytes(buf)"),  # _recv_exact: <=8-byte control fields
-    ("transport/codec.py", "return [TAG_RECORD + item.to_bytes()]"),  # EOS: header-only
-    ("transport/codec.py", "return TAG_RECORD + item.to_bytes()"),  # legacy encode_payload
-    ("transport/codec.py", "tag = bytes(buf[:1])"),  # 1-byte tag peek
-    ("transport/shm_ring.py", "if bytes(mv[:1]) == _TAG_VOID:"),  # 1-byte tag peek
-    ("records.py", "return header + payload.tobytes()"),  # legacy FrameRecord.to_bytes
-    ("records.py", "data = item.to_bytes()  # header-only, tiny"),  # encode_into EOS
-]
-
-
-def _allowed(rel: str, line: str) -> bool:
-    return any(rel.endswith(suf) and sub in line for suf, sub in _HOT_ALLOWLIST)
+def test_no_undefined_names():
+    """The ISSUE 1 screen: latent NameErrors (deferred annotations,
+    version-gated builtins like py3.10 ExceptionGroup) are tier-1."""
+    found = _findings("undefined-name")
+    assert not found, "\n".join(f.render() for f in found)
 
 
 def test_hot_path_has_no_per_frame_allocation_idioms():
-    violations, matched_allow = [], set()
-    for rel in HOT_PATH_FILES:
-        path = PACKAGE_ROOT / rel
-        for ln, line in enumerate(path.read_text().splitlines(), 1):
-            code = line.split("#", 1)[0] if not line.lstrip().startswith("#") else ""
-            if not code.strip():
-                continue
-            for tag, pat in _BANNED:
-                if not pat.search(code):
-                    continue
-                if _allowed(rel, line):
-                    matched_allow.add((rel, line.strip()))
-                    continue
-                violations.append(f"{rel}:{ln} [{tag}] {line.strip()}")
-    assert not violations, (
-        "per-frame allocation idiom on the zero-copy hot path (use "
-        "wire_parts()/sendmsg, pooled recv_into, push_view — or add a "
-        "reviewed allowlist entry):\n  " + "\n  ".join(violations)
-    )
-    stale = [
-        (suf, sub)
-        for suf, sub in _HOT_ALLOWLIST
-        if not any(rel.endswith(suf) and sub in line for rel, line in matched_allow)
-    ]
-    assert not stale, f"allowlist entries no longer match anything (remove them): {stale}"
+    """The ISSUE 2 screen: the zero-copy datapath must not regrow
+    .tobytes()/.to_bytes(/raw .recv(/bytes(...) per-frame idioms."""
+    found = _findings("hot-alloc")
+    assert not found, "\n".join(f.render() for f in found)

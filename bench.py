@@ -2145,10 +2145,20 @@ def _bench_host_datapath(extras, smoke=False):
     gather means a put performs no payload copy at all, so the producer
     contributes 0 to copies/frame here (the server relay contributes 0
     as well — it forwards the pooled buffer it received into).
+
+    The run doubles as the tracing demonstration (ISSUE 4): sampled
+    per-frame tracing is enabled at 1/16 into a scratch spool for the
+    stream, and the resulting span summary + flight-recorder event
+    counts land in bench_full.json (``trace_summary`` /
+    ``flight_events``) — the artifact proves the tracing path works on
+    every bench run, and PERF_NOTES records its measured overhead.
     """
+    import tempfile
     import threading as _threading
 
     from psana_ray_tpu.infeed.batcher import batches_from_queue
+    from psana_ray_tpu.obs.flight import FLIGHT
+    from psana_ray_tpu.obs.tracing import TRACER
     from psana_ray_tpu.records import EndOfStream, FrameRecord
     from psana_ray_tpu.transport import RingBuffer
     from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
@@ -2168,10 +2178,15 @@ def _bench_host_datapath(extras, smoke=False):
     cons = TcpQueueClient("127.0.0.1", srv.port)
     buf_pool = BufferPool.default()
 
+    # sampled tracing rides the same stream (scratch spool, 1-in-16):
+    # the bench artifact then carries a live span summary
+    trace_dir = tempfile.mkdtemp(prefix="bench_trace_")
+    TRACER.configure(trace_dir, sample_every=16, process="bench")
+
     def produce(warmup: int):
         total = warmup + n_frames
         for i in range(total):
-            rec = FrameRecord(0, i, pool16[i % 4], 9.5)
+            rec = FrameRecord(0, i, pool16[i % 4], 9.5, trace=TRACER.maybe_trace())
             if not prod.put_wait(rec, timeout=120.0):
                 raise RuntimeError("producer starved out")
         if not prod.put_wait(EndOfStream(total_events=total), timeout=120.0):
@@ -2219,7 +2234,22 @@ def _bench_host_datapath(extras, smoke=False):
             f"steady-state (pool: {m1['hits']} hits / {m1['misses']} "
             f"misses, {m1['churn_misses']} churn)"
         )
+        # the sampled-trace + flight summaries of this very stream:
+        # proof in the artifact that the tracing path works end to end
+        trace_snap = TRACER.snapshot()
+        extras["trace_summary"] = trace_snap
+        extras["flight_events"] = FLIGHT.snapshot()
+        log(
+            f"trace demo [1/{trace_snap['sample_every']} sampling]: "
+            f"{trace_snap['spans_total']} spans "
+            f"({trace_snap.get('spans_by_name', {})}), flight events: "
+            f"{extras['flight_events']['events_total']}"
+        )
     finally:
+        TRACER.close()
+        import shutil
+
+        shutil.rmtree(trace_dir, ignore_errors=True)  # scratch spool
         for c in (prod, cons):
             try:
                 c.disconnect()

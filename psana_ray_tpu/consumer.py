@@ -148,6 +148,9 @@ class DataReader:
                 tally.flush_duplicates(self._queue)  # a slot just freed
                 if is_eos(item):
                     if tally.process(item):
+                        from psana_ray_tpu.obs.flight import FLIGHT
+
+                        FLIGHT.record("eos_complete", queue=self.queue_name)
                         return
                     continue
                 yield item
@@ -208,9 +211,10 @@ def main(argv=None):
         "seconds — the consumer-side mirror of the producer's end-of-run "
         "summary; 0 = off",
     )
-    from psana_ray_tpu.obs import add_metrics_args
+    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
 
     add_metrics_args(p)
+    add_trace_args(p)
     p.add_argument(
         "--cursor_path", default=None,
         help="persist a StreamCursor (contiguous per-shard watermark of "
@@ -286,29 +290,44 @@ def main(argv=None):
     heartbeat_done = threading.Event()
     heartbeat = None
     if a.status_interval > 0:
+        from psana_ray_tpu.obs.tracing import obs_status_suffix
+
         def _heartbeat():
+            # the suffix shows tracing is actually ON in a live run:
+            # sample rate, spans emitted so far, flight-recorder events
             while not heartbeat_done.wait(a.status_interval):
-                log.info("consumer %d status: %s", a.consumer_id, metrics.status_line())
+                log.info(
+                    "consumer %d status: %s%s",
+                    a.consumer_id, metrics.status_line(), obs_status_suffix(),
+                )
 
         heartbeat = threading.Thread(target=_heartbeat, daemon=True, name="consumer-heartbeat")
         heartbeat.start()
+
+    from psana_ray_tpu.obs.tracing import TRACER, configure_from_args
+    from psana_ray_tpu.obs.stages import STAGE_DEQUEUE
 
     monitor = None
     try:
         with trace(a.profile_dir), DataReader(
             address=a.address, queue_name=a.queue_name, namespace=a.namespace
         ) as reader:
-            if observe_dwell:
+            if observe_dwell or a.trace_dir:
                 # depth in the heartbeat — over a DEDICATED handle, never
                 # the data connection (see DataReader.open_monitor: a
-                # size() probe there would ACK in-flight deliveries)
+                # size() probe there would ACK in-flight deliveries).
+                # Tracing reuses the same handle for its clock-anchor
+                # exchanges (an anchor RPC on the data connection would
+                # ACK in-flight deliveries the same way)
                 try:
                     monitor = reader.open_monitor()
                     metrics.attach_queue(monitor)
                 except Exception as e:  # noqa: BLE001 — depth is optional
                     log.debug("queue monitor unavailable: %s", e)
+            configure_from_args(a, "consumer", queue=monitor)
             try:
                 for rec in reader.iter_records(stop=_should_stop):
+                    t_rec = time.monotonic()
                     n += 1
                     metrics.observe_frame(rec.nbytes)
                     if observe_dwell and rec.timestamp:
@@ -331,6 +350,15 @@ def main(argv=None):
                         cursor.advance(rec.shard_rank, rec.event_idx)
                         if a.cursor_save_every > 0 and n % a.cursor_save_every == 0:
                             cursor.save(a.cursor_path)
+                    rec_trace = rec.trace
+                    if rec_trace is not None and rec_trace.sampled and TRACER.enabled:
+                        # consumer-side span: read done -> record fully
+                        # handled (log + cursor) — strictly after the
+                        # server's relay span on the merged timeline
+                        TRACER.span(
+                            rec_trace.trace_id, STAGE_DEQUEUE,
+                            t_rec, time.monotonic(),
+                        )
             finally:
                 if cursor is not None:
                     cursor.save(a.cursor_path)

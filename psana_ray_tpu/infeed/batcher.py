@@ -17,8 +17,10 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from psana_ray_tpu.obs.flight import FLIGHT
 from psana_ray_tpu.obs.stages import HOP_BATCH, HOP_DEQ, HOP_PUSH
-from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord
+from psana_ray_tpu.obs.tracing import TRACE_KEY, TRACER
+from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord, mark_hop
 from psana_ray_tpu.transport.recovery import return_to_queue
 from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 from psana_ray_tpu.utils.bufpool import WIRE
@@ -294,6 +296,7 @@ def batches_from_queue(
                     if batcher is not None and (tail := batcher.flush()) is not None:
                         yield tail
                     if raise_on_stall:
+                        FLIGHT.record("stream_stalled", max_wait_s=max_wait_s)
                         raise StreamStalled(
                             f"stream silent for {max_wait_s:.1f}s: no data, "
                             f"no EOS (producer stalled or unreachable)"
@@ -336,12 +339,21 @@ def batches_from_queue(
                             return_to_queue(queue, leftover_frames, what="re-popped record")
                         if batcher is not None and (tail := batcher.flush()) is not None:
                             ready.append(tail)
+                        FLIGHT.record("eos_complete", source="batches_from_queue")
                         stream_done = True
                         break
                     continue
                 if batcher is None:
                     batcher = FrameBatcher(batch_size, n_buffers=n_buffers)
-                if item.hops is not None:  # timed stream: stamp the pop
+                trace = item.trace
+                if trace is not None and trace.sampled and TRACER.enabled:
+                    # traced frame from the wire: seed the hops dict so
+                    # the batcher/prefetcher stamps become spans at step
+                    # completion (obs.tracing.emit_batch_spans). TRACE_KEY
+                    # carries the id; stage observation ignores it
+                    mark_hop(item, HOP_DEQ, t_deq)
+                    item.hops[TRACE_KEY] = trace.trace_id
+                elif item.hops is not None:  # timed stream: stamp the pop
                     item.hops[HOP_DEQ] = t_deq
                 out = batcher.push_view(item)  # copy into arena, release lease
                 if out is not None:

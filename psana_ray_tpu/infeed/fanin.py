@@ -131,14 +131,20 @@ class FanInPipeline:
             t.start()
 
     def _pump(self, name: str):
+        from psana_ray_tpu.obs.flight import FLIGHT
+
         pipe = self._pipes[name]
         try:
             for batch in pipe:
                 if not self._put((name, batch)):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            # leg failure is a first-class postmortem event: a dead
+            # detector leg is the fan-in's version of a wedged run
+            FLIGHT.record("fanin_leg_error", leg=name, error=repr(e))
             self._errors.append(e)
         finally:
+            FLIGHT.record("fanin_leg_done", leg=name)
             pipe.close()
             self._put((name, self._DONE), force=True)
 

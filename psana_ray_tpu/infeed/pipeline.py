@@ -34,6 +34,7 @@ from psana_ray_tpu.obs.stages import (
     STAGE_DISPATCH,
     observe_batch_stages,
 )
+from psana_ray_tpu.obs.tracing import emit_batch_spans
 from psana_ray_tpu.utils.metrics import PipelineMetrics
 from psana_ray_tpu.utils.trace import annotate_stage
 
@@ -182,6 +183,10 @@ def drive_step(
     )
     if batch.hops:  # timed stream: fold hop stamps into stage histograms
         observe_batch_stages(metrics.stages, batch, t1)
+        # traced records (TRACE_KEY in their hops) become per-stage spans
+        # on this process's trace track — same boundaries as the
+        # histograms, so timeline and quantiles agree by construction
+        emit_batch_spans(batch, t1)
     return out
 
 

@@ -13,6 +13,15 @@ observability story at all):
   structured backpressure / stall / liveness warnings, and the queue
   server answers a stats RPC (``transport.tcp`` opcode ``T``).
 
+Plus the per-frame layer (ISSUE 4):
+
+- **Tracing** — :mod:`psana_ray_tpu.obs.tracing`: sampled per-frame
+  distributed traces across producer/queue-server/consumer, merged into
+  a Perfetto-loadable timeline by ``python -m psana_ray_tpu.obs.
+  trace_merge``;
+- **Flight recorder** — :mod:`psana_ray_tpu.obs.flight`: bounded event
+  ring + dump-on-stall/exception/SIGUSR2 postmortem black box.
+
 Everything here is pure stdlib and importable without JAX.
 """
 
@@ -41,4 +50,15 @@ from psana_ray_tpu.obs.stall import (  # noqa: F401
     EVENT_PRODUCER_IDLE,
     StallDetector,
     StallEvent,
+)
+from psana_ray_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
+from psana_ray_tpu.obs.tracing import (  # noqa: F401
+    TRACER,
+    TraceContext,
+    Tracer,
+    add_trace_args,
+    configure_from_args as configure_tracing_from_args,
+    emit_batch_spans,
+    exchange_anchors,
+    obs_status_suffix,
 )

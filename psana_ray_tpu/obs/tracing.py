@@ -1,0 +1,474 @@
+"""Sampled per-frame distributed tracing across the pipeline's processes.
+# lint: hot-path
+
+PR 1 gave the pipeline aggregate stage histograms; this module answers the
+question those cannot: *where did THIS frame spend its time* across the
+producer -> queue server -> consumer -> device boundary (the per-request
+trace production streaming systems pair with their counters — tf.data's
+pipeline instrumentation and DALI's per-iteration view, PAPERS.md).
+
+Three pieces:
+
+- :class:`TraceContext` — a compact wire-format context (trace id, sample
+  flag, origin host/pid) that rides the :class:`~psana_ray_tpu.records.
+  FrameRecord` envelope. Sampled frames encode as schema v3 with the
+  25-byte context appended after the shape; UNSAMPLED frames encode as
+  plain v2, byte-identical to the pre-tracing wire format — the
+  unsampled hot path pays zero allocations and zero wire bytes
+  (the same gating discipline as PR 1's ``stage_timing``).
+- :class:`Tracer` — the per-process span sink. Each process appends
+  spans (producer: produce/enqueue; queue server: queue_dwell/relay;
+  consumer: dequeue/batch/device_put/dispatch — reusing the
+  :mod:`psana_ray_tpu.obs.stages` boundaries) to a bounded per-process
+  JSONL spool, together with (wallclock, monotonic) clock anchors and
+  peer-anchor exchanges (tcp opcode ``A``) that let the merge tool put
+  three processes on one timeline.
+- ``python -m psana_ray_tpu.obs.trace_merge`` reads the spools and emits
+  Chrome trace-event JSON loadable in Perfetto / TensorBoard, one track
+  per process, frame spans linked by trace id. The device-side
+  ``stage.*`` annotations (:func:`psana_ray_tpu.utils.trace.
+  annotate_stage`) use the same stage vocabulary, so a jax.profiler
+  capture of the same run lines up against the host spans.
+
+Everything here is pure stdlib (no numpy, no jax) so every process —
+including the queue server — can afford the import. Span recording for
+sampled frames is one lock + one small dict append; the spool is flushed
+in the background of normal operation (every ``FLUSH_EVERY`` spans and at
+process exit), never per span.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import itertools
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "TraceContext",
+    "Tracer",
+    "TRACER",
+    "TRACE_KEY",
+    "SPAN_PRODUCE",
+    "SPAN_RELAY",
+    "add_trace_args",
+    "configure_from_args",
+    "emit_batch_spans",
+    "exchange_anchors",
+    "obs_status_suffix",
+]
+
+# Reserved key in a record's ``hops`` dict carrying the trace id through
+# the in-process batching path (the hops dict already rides the envelope;
+# stage observation iterates only the HOP_* names, so the key is inert
+# there).
+TRACE_KEY = "trace_id"
+
+# Span names beyond the canonical stage names (obs.stages):
+SPAN_PRODUCE = "produce"  # instant: source read done (frame is born)
+SPAN_RELAY = "relay"  # queue server: response serialization + send
+
+_FLAG_SAMPLED = 0x01
+
+# trace_id:u64, origin_pid:u32, flags:u8, origin_host:12s (utf-8, padded)
+_CTX_WIRE = struct.Struct("<QIB12s")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Compact per-frame trace context; rides the record envelope.
+
+    ``trace_id`` is unique per sampled frame across the deployment
+    (origin pid + counter mixed in); ``origin_host``/``origin_pid``
+    identify the producing process for the merged timeline."""
+
+    trace_id: int
+    sampled: bool = True
+    origin_host: str = ""
+    origin_pid: int = 0
+
+    WIRE_SIZE = _CTX_WIRE.size  # 25 bytes on sampled frames only
+
+    def pack(self) -> Any:
+        flags = _FLAG_SAMPLED if self.sampled else 0
+        host = self.origin_host.encode("utf-8", "replace")[:12]
+        return _CTX_WIRE.pack(
+            self.trace_id & 0xFFFFFFFFFFFFFFFF, self.origin_pid & 0xFFFFFFFF,
+            flags, host,
+        )
+
+    @staticmethod
+    def unpack_from(buf, offset: int) -> "TraceContext":
+        trace_id, pid, flags, host = _CTX_WIRE.unpack_from(buf, offset)
+        return TraceContext(
+            trace_id=trace_id,
+            sampled=bool(flags & _FLAG_SAMPLED),
+            origin_host=host.rstrip(b"\0").decode("utf-8", "replace"),
+            origin_pid=pid,
+        )
+
+
+# Spool record tags (one JSON object per line):
+#   m = meta (process identity, sample config)   a = clock anchor
+#   p = peer anchor (tcp opcode 'A' exchange)    s = span   i = instant
+FLUSH_EVERY = 128
+
+
+class Tracer:
+    """Per-process span sink with a bounded JSONL spool.
+
+    Disabled (the default) every surface is a no-op behind ONE attribute
+    check; ``maybe_trace`` on an enabled tracer allocates NOTHING for
+    unsampled frames (counter arithmetic only — pinned by test and the
+    hot-alloc checker's span fixtures)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._every = 0  # sample 1 frame in N; 0 = off
+        # frame ticker: itertools.count.__next__ is atomic in CPython, so
+        # concurrent producer shard threads get UNIQUE frame numbers (and
+        # therefore unique trace ids) without a hot-path lock; _count is
+        # a best-effort gauge of the latest value for snapshot()
+        self._ticker = itertools.count(1)
+        self._count = 0
+        self._id_base = 0
+        self._host = socket.gethostname()
+        self._pid = os.getpid()
+        self._process = ""
+        self._path: Optional[str] = None
+        self._f = None
+        self._buf: list = []
+        self._spans = 0
+        self._drops = 0
+        self._max_spans = 0
+        self._by_name: Dict[str, int] = {}
+        self._atexit_registered = False
+
+    # -- configuration ----------------------------------------------------
+    def configure(
+        self,
+        spool_dir: str,
+        sample_every: int = 100,
+        process: str = "proc",
+        max_spans: int = 200_000,
+    ) -> "Tracer":
+        """Enable tracing: sample 1 frame in ``sample_every`` (1 = every
+        frame) and spool spans to ``spool_dir``. Reconfiguring closes the
+        previous spool first. ``max_spans`` bounds the spool — beyond it
+        spans are dropped and counted (``spans_dropped``), never blocking
+        the pipeline."""
+        if sample_every <= 0:
+            raise ValueError("sample_every must be >= 1 (frames per sample)")
+        with self._lock:
+            self._close_locked()
+            os.makedirs(spool_dir, exist_ok=True)
+            self._process = process
+            self._pid = os.getpid()
+            self._every = int(sample_every)
+            self._ticker = itertools.count(1)
+            self._count = 0
+            self._spans = 0
+            self._drops = 0
+            self._by_name = {}
+            self._max_spans = max_spans
+            # unique-across-processes id space: pid in the top bits, a
+            # wall-clock sub-second salt so quick restarts don't collide
+            salt = int(time.time() * 1e6) & 0xFFFFF
+            self._id_base = ((self._pid & 0xFFFFFFFF) << 28) ^ (salt << 8)
+            self._path = os.path.join(
+                spool_dir, f"{process}-{self._host}-{self._pid}.trace.jsonl"
+            )
+            self._f = open(self._path, "w", encoding="utf-8")
+            self._buf = [
+                self._line(
+                    t="m", process=process, host=self._host, pid=self._pid,
+                    every=self._every, start_wall=time.time(),
+                    start_mono=time.monotonic(),
+                )
+            ]
+            self._anchor_locked()
+            self._flush_locked()
+            self.enabled = True
+            if not self._atexit_registered:
+                self._atexit_registered = True
+                atexit.register(self.close)
+        return self
+
+    @property
+    def spool_path(self) -> Optional[str]:
+        return self._path
+
+    @property
+    def sample_every(self) -> int:
+        return self._every
+
+    # -- hot path ---------------------------------------------------------
+    def maybe_trace(self) -> Optional[TraceContext]:
+        """Per-frame sampling gate (producer side). Disabled: one
+        attribute check. Enabled but unsampled: counter arithmetic only —
+        no allocation, no lock. Sampled: a fresh :class:`TraceContext`.
+
+        Thread-safe without locking: the ticker hands concurrent shard
+        threads unique frame numbers (atomic ``__next__``), and the
+        sample config is read ONCE so a concurrent ``close()`` can never
+        produce a divide-by-zero mid-frame — worst case a frame straddling
+        the close is sampled into a spool that is already flushing."""
+        if not self.enabled:
+            return None
+        every = self._every
+        if every <= 0:  # racing a close(): tracing is over, not an error
+            return None
+        n = next(self._ticker)
+        self._count = n  # best-effort gauge (snapshot/status only)
+        if n % every:
+            return None
+        return TraceContext(
+            trace_id=(self._id_base + n) & 0xFFFFFFFFFFFFFFFF,
+            sampled=True,
+            origin_host=self._host,
+            origin_pid=self._pid,
+        )
+
+    # -- span sinks (sampled frames only) ---------------------------------
+    def span(self, trace_id: int, name: str, t0: float, t1: float) -> None:
+        """One completed span ``[t0, t1]`` in THIS process's monotonic
+        domain (the merge tool aligns domains via the spooled anchors)."""
+        if not self.enabled:
+            return
+        self._emit(name, self._line(t="s", id=trace_id, n=name, a=t0, b=t1))
+
+    def instant(self, trace_id: int, name: str, t: float) -> None:
+        """A zero-duration marker (e.g. ``produce`` at source-read done)."""
+        if not self.enabled:
+            return
+        self._emit(name, self._line(t="i", id=trace_id, n=name, a=t))
+
+    def _emit(self, name: str, line: str) -> None:
+        """THE bounded-spool sink: cap accounting, per-name counts, and
+        the every-``FLUSH_EVERY`` anchor+flush policy live here once."""
+        with self._lock:
+            if self._spans >= self._max_spans:
+                self._drops += 1
+                return
+            self._spans += 1
+            self._by_name[name] = self._by_name.get(name, 0) + 1
+            self._buf.append(line)
+            if len(self._buf) >= FLUSH_EVERY:
+                self._anchor_locked()
+                self._flush_locked()
+
+    # -- clock alignment --------------------------------------------------
+    def write_anchor(self) -> None:
+        """Record a (wallclock, monotonic) pair — the merge tool estimates
+        this process's monotonic->wall offset from the median of these."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._anchor_locked()
+
+    def record_peer_anchor(self, exchange: dict) -> None:
+        """Record one ping/anchor exchange with the queue server (tcp
+        opcode ``A``: local send/recv wall+mono around the server's
+        wall+mono reply) — lets the merge tool align this process to the
+        server's clock across hosts, bounded by the measured RTT."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._buf.append(self._line(t="p", **exchange))
+
+    def _anchor_locked(self) -> None:
+        self._buf.append(self._line(t="a", wall=time.time(), mono=time.monotonic()))
+
+    @staticmethod
+    def _line(**kw) -> str:
+        return json.dumps(kw, separators=(",", ":"))
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._f is None or not self._buf:
+            self._buf = self._buf if self._f is not None else []
+            return
+        self._f.write("\n".join(self._buf) + "\n")
+        self._f.flush()
+        self._buf = []
+
+    def close(self) -> None:
+        """Flush + close the spool and disable. Safe to call repeatedly
+        (registered atexit)."""
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._f is not None:
+            self._anchor_locked()
+            self._flush_locked()
+            try:
+                self._f.close()
+            except OSError:
+                pass
+        self._f = None
+        self.enabled = False
+        self._every = 0
+
+    # -- observability of the observer ------------------------------------
+    def snapshot(self) -> dict:
+        """Registry source: is tracing on, at what rate, how many spans."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "enabled": self.enabled,
+                "sample_every": self._every,
+                "frames_seen_total": self._count,
+                "spans_total": self._spans,
+                "spans_dropped_total": self._drops,
+            }
+            if self._by_name:
+                out["spans_by_name"] = dict(self._by_name)
+        return out
+
+    def status_suffix(self, flight=None) -> str:
+        """Heartbeat-line suffix: sample rate, spans emitted, flight-
+        recorder event count — empty when tracing is off (the line stays
+        exactly as it was before this feature)."""
+        if not self.enabled:
+            return ""
+        with self._lock:
+            every, spans, drops = self._every, self._spans, self._drops
+        suffix = f" trace[1/{every} spans={spans}"
+        if drops:
+            suffix += f" drops={drops}"
+        suffix += "]"
+        if flight is not None:
+            suffix += f" flight={flight.event_count}"
+        return suffix
+
+
+#: The process-global tracer every CLI configures (tests build their own).
+TRACER = Tracer()
+
+
+def emit_batch_spans(batch, t_end: float, tracer: Optional[Tracer] = None) -> None:
+    """Consumer-side spans for one batch: each traced record's hop stamps
+    (``TRACE_KEY`` marks the traced ones) become per-stage spans ending at
+    ``t_end`` (step completion) — the same telescoping walk as
+    :func:`psana_ray_tpu.obs.stages.observe_record_stages`, so span
+    boundaries and histogram boundaries agree by construction. Near-zero
+    cost on untraced streams (``batch.hops`` is None)."""
+    tr = TRACER if tracer is None else tracer
+    if not tr.enabled:
+        return
+    hops_list = getattr(batch, "hops", None)
+    if not hops_list:
+        return
+    from psana_ray_tpu.obs.stages import HOPS, STAGE_DISPATCH, STAGE_ENQUEUE, STAGES
+
+    for hops in hops_list:
+        tid = hops.get(TRACE_KEY)
+        if tid is None:
+            continue
+        prev = None
+        for i, hop in enumerate(HOPS):
+            t = hops.get(hop)
+            if t is None:
+                continue
+            # skip the enqueue leg: the PRODUCER's _Sender.flush already
+            # emitted it (in-process transports share the hops dict, so
+            # replaying src->enq here would double the span)
+            if prev is not None and STAGES[i - 1] != STAGE_ENQUEUE:
+                tr.span(tid, STAGES[i - 1], prev, t)
+            prev = t
+        if prev is not None:
+            tr.span(tid, STAGE_DISPATCH, prev, t_end)
+
+
+def exchange_anchors(queue, n: int = 3, tracer: Optional[Tracer] = None) -> int:
+    """Run ``n`` ping/anchor exchanges against a queue handle that speaks
+    the anchor RPC (``TcpQueueClient.anchor``) and spool them. Returns how
+    many succeeded; 0 for transports without the RPC (in-process / shm —
+    same-host wall clocks already agree)."""
+    tr = TRACER if tracer is None else tracer
+    anchor = getattr(queue, "anchor", None)
+    if not tr.enabled or anchor is None:
+        return 0
+    done = 0
+    for _ in range(n):
+        try:
+            tr.record_peer_anchor(anchor())
+            done += 1
+        except Exception:  # noqa: BLE001 — alignment is best-effort
+            break
+    return done
+
+
+# -- CLI wiring ------------------------------------------------------------
+def add_trace_args(parser) -> None:
+    """The shared ``--trace_dir`` / ``--trace_sample`` / ``--flight_dir``
+    trio every long-running CLI exposes (one definition, like
+    ``add_metrics_args``)."""
+    parser.add_argument(
+        "--trace_dir", default=None,
+        help="enable sampled per-frame distributed tracing: spool spans "
+        "to this directory (one JSONL file per process); merge with "
+        "`python -m psana_ray_tpu.obs.trace_merge <dir>` and open the "
+        "result in Perfetto. Default off (zero cost)",
+    )
+    parser.add_argument(
+        "--trace_sample", type=int, default=100,
+        help="sample 1 frame in N for tracing (1 = every frame); only "
+        "active with --trace_dir. Unsampled frames pay zero allocations",
+    )
+    parser.add_argument(
+        "--flight_dir", default=None,
+        help="crash flight recorder: dump the event ring + metrics "
+        "snapshot + thread stacks here on stall/unhandled exception/"
+        "SIGUSR2 (default: --trace_dir when set, else off)",
+    )
+
+
+def configure_from_args(args, process: str, queue=None) -> Optional[Tracer]:
+    """CLI entry: configure the global tracer + flight recorder from the
+    ``add_trace_args`` flags. Registers both as metrics-registry sources
+    (``trace`` / ``flight``) so /metrics shows tracing is on. ``queue``
+    (optional, a TCP client or monitor handle) seeds the clock alignment
+    with peer-anchor exchanges. Returns the tracer, or None when tracing
+    stays off."""
+    trace_dir = getattr(args, "trace_dir", None)
+    flight_dir = getattr(args, "flight_dir", None) or trace_dir
+    out = None
+    if trace_dir:
+        TRACER.configure(
+            trace_dir, sample_every=max(1, args.trace_sample), process=process
+        )
+        out = TRACER
+    from psana_ray_tpu.obs.flight import FLIGHT
+
+    if flight_dir:
+        FLIGHT.install(flight_dir, process=process)
+    if trace_dir or flight_dir:
+        from psana_ray_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry.default()
+        if trace_dir:
+            reg.register("trace", TRACER)
+        reg.register("flight", FLIGHT)
+    if out is not None and queue is not None:
+        exchange_anchors(queue)
+    return out
+
+
+def obs_status_suffix() -> str:
+    """One-call heartbeat suffix over the global tracer + flight recorder
+    (the consumer/sfx ``--status_interval`` lines append this)."""
+    from psana_ray_tpu.obs.flight import FLIGHT
+
+    return TRACER.status_suffix(FLIGHT)

@@ -1,0 +1,32 @@
+"""jax version compatibility shims for the parallel layer.
+
+``shard_map`` moved twice across the jax line this repo spans: modern
+jax exports it as ``jax.shard_map`` (with ``check_vma``); older builds
+(e.g. 0.4.x, the toolchain baked into some containers) only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent knob is
+``check_rep``. ``from jax import shard_map`` is therefore an ImportError
+on those builds — it took out 10 tests and 17 collection errors on this
+container's seed. Import it from HERE instead; the wrapper presents the
+modern keyword surface on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:  # modern jax: top-level export, check_vma spelling
+    from jax import shard_map as _shard_map_new
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True) -> Any:
+        return _shard_map_new(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+except ImportError:  # jax <= 0.4.x: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True) -> Any:
+        return _shard_map_old(
+            f, mesh, in_specs, out_specs, check_rep=check_vma
+        )

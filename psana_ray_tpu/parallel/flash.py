@@ -48,7 +48,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from psana_ray_tpu.parallel.compat import shard_map
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 

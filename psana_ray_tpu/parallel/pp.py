@@ -33,7 +33,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from psana_ray_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -27,7 +27,9 @@ from typing import List, Optional
 import numpy as np
 
 from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, SourceConfig, TransportConfig
+from psana_ray_tpu.obs.flight import FLIGHT
 from psana_ray_tpu.obs.stages import HOP_ENQ, HOP_SRC, STAGE_ENQUEUE
+from psana_ray_tpu.obs.tracing import SPAN_PRODUCE, TRACER
 from psana_ray_tpu.records import EndOfStream, FrameRecord, mark_hop
 from psana_ray_tpu.sources import open_source
 from psana_ray_tpu.transport import BackoffPolicy, Registry, TransportClosed, TransportWedged
@@ -95,6 +97,14 @@ class _Sender:
                     h = r.hops
                     if h is not None and HOP_SRC in h:
                         self.metrics.stages.observe(STAGE_ENQUEUE, t_try - h[HOP_SRC])
+                    trace = r.trace
+                    if trace is not None and trace.sampled and TRACER.enabled:
+                        # producer-side spans: frame birth (instant) +
+                        # enqueue (source read done -> accepted, incl.
+                        # backpressure wait) — sampled frames only
+                        t_src = h[HOP_SRC] if h and HOP_SRC in h else t_try
+                        TRACER.instant(trace.trace_id, SPAN_PRODUCE, t_src)
+                        TRACER.span(trace.trace_id, STAGE_ENQUEUE, t_src, t_try)
                 del self.pending[:accepted]
                 self.backoff.reset()
             else:
@@ -183,8 +193,14 @@ class ProducerRuntime:
                     break
                 if mask is not None:
                     data = np.where(mask, data, 0)  # parity: producer.py:92-95
-                rec = FrameRecord(rank, int(idx), data, energy, timestamp=time.time())
-                if self.stage_timing:
+                # sampled tracing gate: None on the unsampled hot path
+                # (zero allocations — counter arithmetic only)
+                trace_ctx = TRACER.maybe_trace()
+                rec = FrameRecord(
+                    rank, int(idx), data, energy, timestamp=time.time(),
+                    trace=trace_ctx,
+                )
+                if self.stage_timing or trace_ctx is not None:
                     mark_hop(rec, HOP_SRC)  # source read done
                 if not sender.send(rec):
                     logger.warning("rank %d: queue dead, exiting", rank)
@@ -233,6 +249,11 @@ class ProducerRuntime:
             except TransportClosed:
                 logger.warning("queue died before EOS could be delivered")
                 return
+        FLIGHT.record(
+            "eos_emitted",
+            producer_rank=self.shard_rank_offset,
+            consumers=t.num_consumers,
+        )
         logger.info("EOS delivered to %d consumer(s)", t.num_consumers)
 
     def _resume_point(self, rank: int) -> int:
@@ -308,9 +329,10 @@ def parse_arguments(argv=None):
     p.add_argument("--num_consumers", type=int, default=1)
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--log_level", default="INFO")
-    from psana_ray_tpu.obs import add_metrics_args
+    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
 
     add_metrics_args(p)
+    add_trace_args(p)
     p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
     p.add_argument("--num_events", type=int, default=1024, help="synthetic events")
     p.add_argument(
@@ -433,7 +455,18 @@ def main(argv=None):
             runtime.metrics.attach_queue(monitor)
         except Exception as e:  # noqa: BLE001 — depth is optional
             logger.debug("queue monitor unavailable: %s", e)
+    from psana_ray_tpu.obs.tracing import configure_from_args, exchange_anchors
+
+    tracer = configure_from_args(args, "producer", queue=monitor)
     try:
+        if tracer is not None and monitor is None:
+            # clock alignment against the queue server (tcp opcode 'A'):
+            # configure_from_args already exchanged over the monitor when
+            # one exists; otherwise the data client speaks it too —
+            # harmless pre-stream (a producer connection never holds
+            # in-flight deliveries an opcode could ACK)
+            runtime.bootstrap()
+            exchange_anchors(runtime._queue)
         runtime.run(block=True)
     finally:
         if metrics_server is not None:

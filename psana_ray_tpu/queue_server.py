@@ -55,9 +55,10 @@ def main(argv=None):
             "empty or this many seconds pass, THEN closes (0 = abrupt)"
         ),
     )
-    from psana_ray_tpu.obs import add_metrics_args
+    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
 
     add_metrics_args(p)
+    add_trace_args(p)
     p.add_argument(
         "--stall_poll_s", type=float, default=1.0,
         help="queue-health poll interval for the stall detector "
@@ -127,12 +128,22 @@ def main(argv=None):
     # steady state is visible on the same endpoint.
     MetricsRegistry.default().register("queue_server", server.stats_all)
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    # Tracing (relay spans: queue_dwell/relay per sampled frame) and the
+    # flight recorder (dump-on-stall/SIGUSR2/exception — the black box for
+    # wedged runs) arm from the shared --trace_dir/--flight_dir flags.
+    from psana_ray_tpu.obs import FLIGHT, configure_tracing_from_args
+
+    configure_tracing_from_args(a, "queue_server")
     stall = None
     if a.stall_poll_s > 0:
         stall = StallDetector(
             poll_interval_s=a.stall_poll_s,
             full_threshold_s=a.stall_full_s,
             idle_threshold_s=a.stall_idle_s,
+            # every stall event lands in the flight ring; when a dump dir
+            # is armed the firing ALSO writes the postmortem black box
+            # (events + metrics snapshot + all thread stacks)
+            on_event=FLIGHT.on_stall,
         ).watch_provider(server.queues_by_name)
         MetricsRegistry.default().register("stalls", stall)
         stall.start()

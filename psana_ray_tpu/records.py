@@ -22,9 +22,16 @@ from typing import Optional
 
 import numpy as np
 
+from psana_ray_tpu.obs.tracing import TraceContext
 from psana_ray_tpu.utils.bufpool import WIRE
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
+# Frames WITHOUT a trace context encode as v2 — byte-identical to the
+# pre-tracing wire format, so unsampled streams pay zero extra wire
+# bytes and zero extra allocations. A trace context (ISSUE 4 sampled
+# distributed tracing) bumps that frame to v3 with the compact context
+# appended after the shape.
+_UNTRACED_WIRE_VERSION = 2
 
 # Wire format magics (little-endian u32).
 _FRAME_MAGIC = 0x50525446  # "PRTF" — psana-ray-tpu frame
@@ -81,6 +88,12 @@ class FrameRecord:
     # (FrameBatcher.push_view), and GC of the record releases as a
     # backstop. None (the default) means the record owns its data.
     lease: Optional[object] = dataclasses.field(default=None, repr=False)
+    # Sampled distributed-tracing context (obs.tracing) — ON the wire
+    # (unlike hops): the trace id must link this frame's spans across the
+    # producer / queue-server / consumer processes. None (the default and
+    # the unsampled case) keeps the wire format at v2, byte-identical to
+    # pre-tracing encoders.
+    trace: Optional[TraceContext] = dataclasses.field(default=None, repr=False)
 
     def __post_init__(self):
         panels = np.asarray(self.panels)
@@ -143,7 +156,7 @@ class FrameRecord:
             WIRE.add(panels.nbytes)
         header = _FRAME_HEADER.pack(
             _FRAME_MAGIC,
-            self.schema_version,
+            self._wire_version(),
             self.shard_rank,
             self.event_idx,
             panels.ndim,
@@ -151,7 +164,14 @@ class FrameRecord:
             float(self.photon_energy),
             float(self.timestamp),
         ) + struct.pack(f"<{panels.ndim}q", *panels.shape)
+        if self.trace is not None:  # v3: compact trace context after shape
+            header += self.trace.pack()
         return header, panels.data.cast("B")
+
+    def _wire_version(self) -> int:
+        """v2 for untraced frames (byte-identical to pre-tracing
+        encoders), v3 when a trace context must ride along."""
+        return SCHEMA_VERSION if self.trace is not None else _UNTRACED_WIRE_VERSION
 
     def to_bytes(self) -> bytes:
         header, payload = self.wire_parts()
@@ -172,6 +192,10 @@ class FrameRecord:
         off = _FRAME_HEADER.size
         shape = struct.unpack_from(f"<{ndim}q", buf, off)
         off += 8 * ndim
+        trace = None
+        if version >= 3:  # sampled frame: trace context between shape and payload
+            trace = TraceContext.unpack_from(buf, off)
+            off += TraceContext.WIRE_SIZE
         if dtype_code not in _CODE_DTYPES:
             raise ValueError(f"unknown dtype code {dtype_code}")
         dtype = _CODE_DTYPES[dtype_code]
@@ -186,6 +210,7 @@ class FrameRecord:
             photon_energy=energy,
             timestamp=ts,
             schema_version=version,
+            trace=trace,
         )
 
 
@@ -386,7 +411,11 @@ def encoded_size(item) -> int:
     """Exact wire size of ``to_bytes()`` without building it — lets a
     zero-copy transport reserve the right slot span up front."""
     if isinstance(item, FrameRecord):
-        return _FRAME_HEADER.size + 8 * item.panels.ndim + int(item.panels.nbytes)
+        trace_bytes = TraceContext.WIRE_SIZE if item.trace is not None else 0
+        return (
+            _FRAME_HEADER.size + 8 * item.panels.ndim + trace_bytes
+            + int(item.panels.nbytes)
+        )
     if isinstance(item, EndOfStream):
         return _EOS_HEADER.size
     raise TypeError(f"not a wire record: {type(item)!r}")
@@ -408,7 +437,7 @@ def encode_into(item, buf) -> int:
         mv,
         0,
         _FRAME_MAGIC,
-        item.schema_version,
+        item._wire_version(),
         item.shard_rank,
         item.event_idx,
         panels.ndim,
@@ -419,6 +448,10 @@ def encode_into(item, buf) -> int:
     off = _FRAME_HEADER.size
     struct.pack_into(f"<{panels.ndim}q", mv, off, *panels.shape)
     off += 8 * panels.ndim
+    if item.trace is not None:  # v3: trace context between shape and payload
+        ctx = item.trace.pack()
+        mv[off : off + len(ctx)] = ctx
+        off += len(ctx)
     dst = np.frombuffer(mv, dtype=panels.dtype, count=panels.size, offset=off)
     np.copyto(dst, panels.reshape(-1))
     WIRE.add(panels.nbytes)

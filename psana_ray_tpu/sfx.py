@@ -336,6 +336,10 @@ class SfxPipeline:
                 queue, self.cfg.batch_size, poll_interval_s=poll_interval_s, stop=stop
             ):
                 nxt = self.dispatch(batch)
+                if batch.hops:  # traced records -> per-stage spans
+                    from psana_ray_tpu.obs.tracing import emit_batch_spans
+
+                    emit_batch_spans(batch, time.monotonic())
                 # clear ``pending`` BEFORE draining it: if drain raises
                 # after its writer.append, the finally below must not
                 # drain the same handle again (duplicate CXI rows)
@@ -430,9 +434,10 @@ def main(argv=None):
         help="allow truncating an existing --output on a FRESH run "
         "(resumed runs — cursor already has positions — always append)",
     )
-    from psana_ray_tpu.obs import add_metrics_args
+    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
 
     add_metrics_args(ap)
+    add_trace_args(ap)
     ap.add_argument("--log_level", default="INFO")
     a = ap.parse_args(argv)
     logging.basicConfig(
@@ -564,6 +569,12 @@ def main(argv=None):
             ).open_monitor()
         except Exception as e:  # noqa: BLE001 — depth is optional
             log.debug("queue monitor unavailable: %s", e)
+    # sampled distributed tracing + flight recorder (shared flags): the
+    # monitor handle doubles as the clock-anchor exchange channel — an
+    # anchor RPC on the data connection would ACK in-flight deliveries
+    from psana_ray_tpu.obs import configure_tracing_from_args
+
+    configure_tracing_from_args(a, "sfx", queue=monitor)
     try:
         with CxiWriter(a.output, max_peaks=a.max_peaks, mode=writer_mode) as writer:
             # features already cross-checked above (one source of truth:

@@ -10,6 +10,13 @@ framing uses the scatter-gather form (:func:`encode_payload_parts` +
 bytes object; :func:`encode_payload` remains for callers that genuinely
 need one buffer. The shared decoder accepts an optional buffer lease for
 zero-copy records (see :func:`psana_ray_tpu.records.decode`).
+
+Distributed-tracing contract (ISSUE 4): a sampled frame's
+:class:`~psana_ray_tpu.obs.tracing.TraceContext` is part of the record
+wire format itself (schema v3, records.py), so every path through this
+codec — contiguous, scatter-gather, or encode-into-slot — preserves it
+across transports with no codec-level branches; untraced frames encode
+as v2, byte-identical to pre-tracing wire.
 """
 
 from __future__ import annotations

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, List, Optional
 
+from psana_ray_tpu.obs.flight import FLIGHT
 from psana_ray_tpu.records import EndOfStream, FrameRecord, encode_into, encoded_size
 from psana_ray_tpu.transport.codec import TAG_PICKLE as _TAG_PICKLE
 from psana_ray_tpu.transport.codec import TAG_RECORD as _TAG_RECORD
@@ -223,6 +224,9 @@ class ShmRingBuffer:
             self._lib.shmring_set_stall_timeout(self._live_handle(), int(seconds * 1000))
 
     def _wedged_msg(self, peer: str, verb: str) -> str:
+        # breadcrumb for the flight recorder: a wedged ring is the exact
+        # postmortem case the black box exists for
+        FLIGHT.record("shm_wedged", ring=self.name, peer=peer)
         return (
             f"shm ring {self.name!r} is wedged: a {peer} process claimed a "
             f"slot and never {verb} it (likely crashed mid-operation). "

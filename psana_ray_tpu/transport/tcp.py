@@ -17,6 +17,12 @@ Wire protocol (all little-endian):
                          + maxsize:u32
               'T' (stats) — queue-health RPC: depth, high-water mark,
                   put/get counters, liveness ages of the bound queue
+              'A' (anchor) — clock ping/anchor exchange (the stats RPC's
+                  tracing sibling): client sends its wall:f64 + mono:f64,
+                  server replies with its own pair; the client records
+                  the exchange so the trace merge tool (obs.trace_merge)
+                  can align this host's clock to the server's, bounded
+                  by the measured RTT
               'F' (bye) — no response; acks the last delivery and ends
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
@@ -24,6 +30,7 @@ Wire protocol (all little-endian):
               + [B ok] count:u32 + count x (len:u32 + payload)
               + [Q ok] accepted:u32
               + [T ok] len:u32 + JSON stats object
+              + [A ok] wall:f64 + mono:f64
 
 Delivery contract (PART OF THE WIRE PROTOCOL, not a server detail): the
 server holds each GET/B delivery as in-flight until the SAME connection's
@@ -89,8 +96,13 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Any, List, Optional
 
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.stages import HOP_ENQ, STAGE_QUEUE_DWELL
+from psana_ray_tpu.obs.tracing import SPAN_RELAY, TRACER
+from psana_ray_tpu.records import mark_hop
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY, RingBuffer
 from psana_ray_tpu.transport.codec import (
@@ -109,6 +121,7 @@ _OP_GET_BATCH = b"B"
 _OP_PUT_BATCH = b"Q"
 _OP_OPEN = b"O"
 _OP_STATS = b"T"
+_OP_ANCHOR = b"A"
 _OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
@@ -245,6 +258,34 @@ def _send_response_payload(conn: socket.socket, item) -> None:
     _sendmsg_all(conn, [head, *parts])
 
 
+# -- relay-side tracing (sampled frames only; gated on TRACER.enabled) ----
+def _stamp_relay_arrival(item) -> None:
+    """Mark a sampled frame's arrival at the relay (server PUT decode) —
+    the start of its queue-dwell span. The stamp lives in the record's
+    process-local hops dict, which survives the in-memory queue hop to
+    the GET that delivers it (shm-backed queues re-encode and lose it;
+    the merge timeline shows dwell as the producer->consumer gap there)."""
+    trace = getattr(item, "trace", None)
+    if trace is not None and trace.sampled:
+        mark_hop(item, HOP_ENQ)
+
+
+def _emit_relay_spans(items, t_send0: float) -> None:
+    """After a GET/B response went out: per sampled frame, a
+    ``queue_dwell`` span (relay arrival -> response start) and a
+    ``relay`` span (response serialization + send)."""
+    t_done = time.monotonic()
+    for item in items:
+        trace = getattr(item, "trace", None)
+        if trace is None or not trace.sampled:
+            continue
+        hops = getattr(item, "hops", None)
+        t_arrived = hops.get(HOP_ENQ) if hops else None
+        if t_arrived is not None:
+            TRACER.span(trace.trace_id, STAGE_QUEUE_DWELL, t_arrived, t_send0)
+        TRACER.span(trace.trace_id, SPAN_RELAY, t_send0, t_done)
+
+
 class TcpQueueServer:
     """Serve queues over TCP: one default queue plus any number of named
     queues that clients OPEN by (namespace, queue_name) — see the module
@@ -295,6 +336,7 @@ class TcpQueueServer:
             if q is None:
                 q = self._queue_factory(namespace, queue_name, maxsize or self._maxsize)
                 self._queues[key] = q
+                FLIGHT.record("queue_opened", namespace=namespace, name=queue_name)
             return q
 
     def named_queues(self) -> List[tuple]:
@@ -331,6 +373,7 @@ class TcpQueueServer:
         Propagates to the backing queues themselves so producers that
         BYPASS TCP (shm-backed deployments, queue_server --shm) are
         refused too, not just the ones speaking the wire protocol."""
+        FLIGHT.record("begin_drain", port=self.port)
         self._draining = True
         for q in self.all_queues():
             drain = getattr(q, "begin_drain", None)
@@ -357,6 +400,7 @@ class TcpQueueServer:
     def close_all(self):
         """Close the default + every named queue (server teardown: every
         blocked client must observe a dead transport, ``ray stop`` parity)."""
+        FLIGHT.record("close_all", port=self.port)
         for q in self.all_queues():
             try:
                 q.close()
@@ -401,6 +445,8 @@ class TcpQueueServer:
         a logged drop for backings without ``put_front`` (shm ring)."""
         from psana_ray_tpu.transport.recovery import return_to_queue
 
+        if items:
+            FLIGHT.record("requeue_in_flight", count=len(items))
         return_to_queue(queue, items, what="in-flight frame")
 
     def _serve_conn(self, conn: socket.socket):
@@ -427,6 +473,8 @@ class TcpQueueServer:
                         # lands in a pooled lease; frames decode zero-copy
                         # and ride the queue still viewing that buffer
                         item = _recv_payload(conn, n, self._pool)
+                        if TRACER.enabled:
+                            _stamp_relay_arrival(item)
                         if self._draining:
                             conn.sendall(_ST_CLOSED)
                             continue
@@ -438,7 +486,10 @@ class TcpQueueServer:
                             conn.sendall(_ST_NO)
                         else:
                             in_flight = [item]  # held until the next opcode
+                            t_send0 = time.monotonic() if TRACER.enabled else 0.0
                             _send_response_payload(conn, item)
+                            if TRACER.enabled:
+                                _emit_relay_spans(in_flight, t_send0)
                     elif op == _OP_GET_BATCH:
                         (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
                         items = queue.get_batch(min(max_items, 4096), timeout=0.0)
@@ -448,7 +499,10 @@ class TcpQueueServer:
                             item_parts = _encode_parts(item)
                             parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
                             parts.extend(item_parts)
+                        t_send0 = time.monotonic() if TRACER.enabled else 0.0
                         _sendmsg_all(conn, parts)
+                        if TRACER.enabled:
+                            _emit_relay_spans(in_flight, t_send0)
                     elif op == _OP_PUT_BATCH:
                         # read the WHOLE request before touching the queue:
                         # an error mid-put (closed transport) must not leave
@@ -458,6 +512,9 @@ class TcpQueueServer:
                         for _ in range(count):
                             (n,) = struct.unpack("<I", _recv_exact(conn, 4))
                             batch.append(_recv_payload(conn, n, self._pool))
+                        if TRACER.enabled:
+                            for item in batch:
+                                _stamp_relay_arrival(item)
                         if self._draining:
                             conn.sendall(_ST_CLOSED)
                             continue
@@ -472,6 +529,16 @@ class TcpQueueServer:
                     elif op == _OP_STATS:
                         payload = json.dumps(_queue_stats_payload(queue)).encode()
                         conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
+                    elif op == _OP_ANCHOR:
+                        # clock ping/anchor exchange (trace alignment):
+                        # read the client's pair, answer with ours — the
+                        # client brackets our reply between two local
+                        # samples and records the exchange to its spool
+                        _recv_exact(conn, 16)  # client wall+mono (RTT symmetry)
+                        conn.sendall(
+                            _ST_OK
+                            + struct.pack("<dd", time.time(), time.monotonic())
+                        )
                     elif op == _OP_CLOSE:
                         queue.close()
                         conn.sendall(_ST_OK)
@@ -621,6 +688,11 @@ class TcpQueueClient:
         ``self._lock`` (except from __init__, where no peer exists yet)."""
         import time
 
+        # flight-recorder breadcrumb: reconnect storms are the leading
+        # indicator in most wedged-run postmortems
+        FLIGHT.record(
+            "reconnect", host=self.host, port=self.port, cause=repr(cause)
+        )
         sock = getattr(self, "_sock", None)
         if sock is not None:
             try:
@@ -723,6 +795,35 @@ class TcpQueueClient:
             self._status()
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             return n
+
+        if deadline is None:
+            deadline = time.monotonic() + self.PROBE_DEADLINE_S
+        with self._lock:
+            return self._retrying(_do, deadline)
+
+    def anchor(self, deadline: Optional[float] = None) -> dict:
+        """Clock ping/anchor exchange (opcode 'A', the stats RPC's tracing
+        sibling): returns the server's (wall, mono) pair bracketed by this
+        process's own samples, plus the measured RTT — exactly what
+        :func:`psana_ray_tpu.obs.tracing.exchange_anchors` spools so the
+        trace merge tool can align this host's clock to the server's."""
+
+        def _do():
+            t0_wall, t0_mono = time.time(), time.monotonic()
+            self._sock.sendall(_OP_ANCHOR + struct.pack("<dd", t0_wall, t0_mono))
+            self._status()
+            peer_wall, peer_mono = struct.unpack("<dd", _recv_exact(self._sock, 16))
+            t1_wall, t1_mono = time.time(), time.monotonic()
+            return {
+                "send_wall": t0_wall,
+                "send_mono": t0_mono,
+                "recv_wall": t1_wall,
+                "recv_mono": t1_mono,
+                "peer_wall": peer_wall,
+                "peer_mono": peer_mono,
+                "rtt_s": t1_mono - t0_mono,
+                "peer": f"{self.host}:{self.port}",
+            }
 
         if deadline is None:
             deadline = time.monotonic() + self.PROBE_DEADLINE_S

@@ -1,0 +1,26 @@
+# lint: hot-path
+"""BAD: per-frame allocation idioms on the tracing span hot path —
+serializing a trace context through frame-sized copies or fresh bytes.
+The span path runs inside the transport hot loop; the same hot-alloc
+bans apply to it as to the datapath (ISSUE 4 satellite)."""
+
+
+def attach_context_to_wire(rec, ctx_struct):
+    # materializing the whole record to splice a 25-byte context in is a
+    # frame-sized copy per sampled frame
+    return rec.to_bytes() + ctx_struct
+
+
+def read_span_record(sock, n):
+    # a fresh bytes object per chunk on the spool-reader hot loop
+    return sock.recv(n)
+
+
+def spool_span(f, payload_mv):
+    # bytes(...) materialization of the span buffer before writing
+    f.write(bytes(payload_mv))
+
+
+def pack_context_slow(panels):
+    # frame-sized ndarray -> bytes serialization to hash a trace id
+    return hash(panels.tobytes())

@@ -1,0 +1,28 @@
+# lint: hot-path
+"""GOOD: the sanctioned span-path idioms — a fixed-size struct pack for
+the wire context (no frame-sized copies), counter-only gating for
+unsampled frames (no allocation), buffered JSONL spool writes."""
+
+import json
+import struct
+
+_CTX = struct.Struct("<QIB12s")
+
+
+def attach_context_to_wire(header, ctx):
+    # the context is its own small header part; the frame payload stays
+    # a zero-copy memoryview (scatter-gather send)
+    return header + _CTX.pack(ctx.trace_id, ctx.origin_pid, 1, b"host")
+
+
+def maybe_trace(state):
+    # unsampled gate: counter arithmetic only, no objects
+    state.count += 1
+    if state.count % state.every:
+        return None
+    return state.make_context()
+
+
+def spool_span(buf, trace_id, name, t0, t1):
+    # buffered JSONL append; flushed in batches, never per span
+    buf.append(json.dumps({"t": "s", "id": trace_id, "n": name, "a": t0, "b": t1}))

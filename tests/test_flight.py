@@ -1,0 +1,239 @@
+"""Tests for ISSUE 4: the crash flight recorder (postmortem black box).
+
+Satellite checklist coverage: dump-on-stall (StallDetector ``on_event``
+wiring) and dump-on-SIGUSR2, plus the dump contents contract — the
+triggering StallEvent, a metrics-registry snapshot, and every thread's
+stack."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from psana_ray_tpu.obs.flight import DUMP_MIN_INTERVAL_S, FlightRecorder
+from psana_ray_tpu.obs.registry import MetricsRegistry
+from psana_ray_tpu.obs.stall import EVENT_BACKPRESSURE, StallDetector, StallEvent
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    fl = FlightRecorder()
+    fl.install(str(tmp_path), process="test")
+    yield fl, tmp_path
+    fl.uninstall()
+
+
+def _dumps(tmp_path):
+    return sorted(tmp_path.glob("flight-*.json"))
+
+
+class TestRing:
+    def test_bounded_ring_keeps_last_n(self):
+        fl = FlightRecorder(maxlen=4)
+        for i in range(10):
+            fl.record("evt", i=i)
+        evts = fl.events()
+        assert len(evts) == 4 and [e["i"] for e in evts] == [6, 7, 8, 9]
+        assert fl.event_count == 10  # total survives eviction
+
+    def test_events_carry_wall_and_mono(self):
+        fl = FlightRecorder()
+        fl.record("reconnect", host="h")
+        (e,) = fl.events()
+        assert e["kind"] == "reconnect" and e["wall"] > 0 and e["mono"] > 0
+        assert e["host"] == "h"
+
+    def test_snapshot_is_a_registry_source(self):
+        fl = FlightRecorder()
+        fl.record("eos_complete")
+        fl.record("eos_complete")
+        fl.record("reconnect")
+        snap = fl.snapshot()
+        assert snap["events_total"] == 3
+        assert snap["events_eos_complete_total"] == 2
+        assert snap["armed"] is False
+
+    def test_unarmed_dump_returns_none(self):
+        assert FlightRecorder().dump("nothing") is None
+
+
+class TestDumpOnStall:
+    def test_stall_event_triggers_dump_with_contents(self, recorder):
+        fl, tmp_path = recorder
+        MetricsRegistry.default().register("unit", {"frames_total": 7})
+        fl.record("reconnect", host="queue-host")
+        # a simulated stall: drive the detector's poll loop over a queue
+        # that sits pegged at maxsize past the threshold
+        det = StallDetector(full_threshold_s=1.0, on_event=fl.on_stall)
+
+        class Full:
+            def stats(self):
+                return {"depth": 8, "maxsize": 8, "puts": 1, "gets": 0}
+
+        det.watch("q", Full())
+        det.poll_once(now=100.0)
+        det.poll_once(now=102.0)  # threshold crossed -> event -> dump
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "stall"
+        # the triggering StallEvent rides the dump
+        assert doc["trigger"]["kind"] == EVENT_BACKPRESSURE
+        assert doc["trigger"]["queue"] == "q" and doc["trigger"]["depth"] == 8
+        # the ring (incl. pre-stall breadcrumbs) is in the dump
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "reconnect" in kinds and "stall" in kinds
+        # a metrics-registry snapshot is embedded
+        assert doc["metrics"]["unit"]["frames_total"] == 7
+        # every thread's stack, including this one
+        assert doc["threads"]
+        assert any(
+            "test_stall_event_triggers_dump" in "\n".join(stack)
+            for stack in doc["threads"].values()
+        )
+
+    def test_dump_rate_limit(self, recorder):
+        fl, tmp_path = recorder
+        ev = StallEvent(EVENT_BACKPRESSURE, "q", 1.0, 8, 8)
+        fl.on_stall(ev)
+        fl.on_stall(ev)  # within DUMP_MIN_INTERVAL_S: suppressed
+        assert len(_dumps(tmp_path)) == 1
+        assert DUMP_MIN_INTERVAL_S > 0
+        # both events still recorded even when the dump was suppressed
+        assert fl.snapshot()["events_stall_total"] == 2
+
+
+class TestDumpOnSignal:
+    def test_sigusr2_dumps(self, recorder):
+        fl, tmp_path = recorder
+        fl.record("eos_complete")
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 5.0
+        while not _dumps(tmp_path) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        dumps = _dumps(tmp_path)
+        assert dumps, "SIGUSR2 did not produce a flight dump"
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "signal"
+        assert any(e["kind"] == "sigusr2" for e in doc["events"])
+        assert doc["threads"]
+
+    def test_uninstall_restores_handler(self, tmp_path):
+        prev = signal.getsignal(signal.SIGUSR2)
+        fl = FlightRecorder()
+        fl.install(str(tmp_path), process="t")
+        assert signal.getsignal(signal.SIGUSR2) == fl._on_signal
+        fl.uninstall()
+        assert signal.getsignal(signal.SIGUSR2) == prev
+
+    def test_install_off_main_thread_still_arms_dumps(self, tmp_path):
+        # signal.signal is main-thread-only; install must degrade to
+        # excepthook + programmatic triggers instead of raising
+        fl = FlightRecorder()
+        err = []
+
+        def go():
+            try:
+                fl.install(str(tmp_path), process="bg", excepthook=False)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=go)
+        t.start()
+        t.join(timeout=5.0)
+        assert not err
+        assert fl.dump("manual", force=True) is not None
+
+
+class TestDumpOnException:
+    def test_excepthook_dumps_and_chains(self, tmp_path):
+        fl = FlightRecorder()
+        seen = []
+        import sys
+
+        prev_hook = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            fl.install(str(tmp_path), process="t")
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+        finally:
+            fl.uninstall()
+            sys.excepthook = prev_hook
+        dumps = _dumps(tmp_path)
+        assert dumps
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "exception"
+        assert doc["trigger"]["exc_type"] == "RuntimeError"
+        assert "boom" in doc["trigger"]["message"]
+        assert seen, "previous excepthook was not chained"
+
+
+class TestDumpOnThreadException:
+    def test_worker_thread_crash_dumps(self, tmp_path):
+        # sys.excepthook never fires for non-main threads; the recorder
+        # must chain threading.excepthook to catch crashing workers
+        fl = FlightRecorder()
+        # park a no-op as the chained hook: the recorder must still call
+        # the previous hook, but pytest's own threading hook would turn
+        # this deliberate crash into a test error
+        prev = threading.excepthook
+        threading.excepthook = lambda args: None
+        fl.install(str(tmp_path), process="t")
+        try:
+            t = threading.Thread(
+                target=lambda: (_ for _ in ()).throw(ValueError("worker boom")),
+                name="doomed-worker",
+            )
+            t.start()
+            t.join(timeout=5.0)
+        finally:
+            fl.uninstall()
+            threading.excepthook = prev
+        dumps = _dumps(tmp_path)
+        assert dumps, "worker-thread crash did not produce a flight dump"
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "thread_exception"
+        assert doc["trigger"]["thread"] == "doomed-worker"
+        assert doc["trigger"]["exc_type"] == "ValueError"
+
+    def test_uninstall_restores_threading_hook(self, tmp_path):
+        prev = threading.excepthook
+        fl = FlightRecorder()
+        fl.install(str(tmp_path), process="t")
+        assert threading.excepthook == fl._on_thread_exception
+        fl.uninstall()
+        assert threading.excepthook == prev
+
+
+class TestWiring:
+    def test_tcp_reconnect_records_breadcrumb(self):
+        from psana_ray_tpu.obs import flight as flight_mod
+        from psana_ray_tpu.transport.registry import TransportClosed
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        before = flight_mod.FLIGHT.snapshot().get("events_reconnect_total", 0)
+        with pytest.raises(TransportClosed):
+            TcpQueueClient(
+                "127.0.0.1", 1, timeout_s=0.2,
+                reconnect_tries=1, reconnect_base_s=0.01,
+            )
+        after = flight_mod.FLIGHT.snapshot().get("events_reconnect_total", 0)
+        assert after > before
+
+    def test_queue_server_wires_stall_dumps(self):
+        # the CLI passes FLIGHT.on_stall into its StallDetector — pin the
+        # wiring so a refactor can't silently drop the black box
+        import inspect
+
+        import psana_ray_tpu.queue_server as qs
+
+        src = inspect.getsource(qs.main)
+        assert "on_event=FLIGHT.on_stall" in src

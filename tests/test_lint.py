@@ -179,6 +179,51 @@ def test_unattached_guarded_by_annotation_is_a_finding():
         path.unlink()
 
 
+def test_hot_alloc_covers_the_span_hot_path_fixtures():
+    """ISSUE 4 satellite: the tracing span path is hot-path territory —
+    the opt-in marker pair pins that hot-alloc keeps flagging per-frame
+    allocation idioms there and passes the sanctioned struct-pack /
+    counter-gate / buffered-spool patterns."""
+    bad = FIXTURES / "span_hot_path_bad.py"
+    good = FIXTURES / "span_hot_path_good.py"
+    flagged = run_lint(paths=[bad], checkers=["hot-alloc"], use_allowlist=False)
+    tags = {f.message.split("]")[0].lstrip("[") for f in flagged.findings}
+    assert {"to_bytes-call", "raw-recv", "bytes-materialize", "tobytes"} <= tags, (
+        flagged.findings
+    )
+    clean = run_lint(paths=[good], checkers=["hot-alloc"], use_allowlist=False)
+    assert not clean.findings, clean.findings
+
+
+def test_tracing_module_is_under_the_hot_alloc_screen():
+    # obs/tracing.py opts in via the exact marker line — the span emit
+    # path stays covered without editing the checker's built-in list
+    tracing = REPO_ROOT / "psana_ray_tpu" / "obs" / "tracing.py"
+    head = tracing.read_text().splitlines()[:5]
+    assert any(ln.strip() == "# lint: hot-path" for ln in head)
+    result = run_lint(paths=[tracing], checkers=["hot-alloc"], use_allowlist=False)
+    assert not result.findings, result.findings
+
+
+def test_wire_protocol_checker_verifies_anchor_opcode_both_ways():
+    """The new clock-anchor opcode ('A', ISSUE 4) must stay wired on
+    both sides: deleting either the client sender or the server dispatch
+    arm becomes a tier-1 failure, not a runtime protocol error."""
+    import ast
+
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    tree = ast.parse(tcp.read_text())
+    assert any(
+        isinstance(n, ast.Assign)
+        and isinstance(n.targets[0], ast.Name)
+        and n.targets[0].id == "_OP_ANCHOR"
+        for n in tree.body
+    ), "_OP_ANCHOR opcode constant missing from tcp.py"
+    # the generic checker sees it both ways (whole-file scan stays clean)
+    result = run_lint(paths=[tcp], checkers=["wire-protocol"])
+    assert not result.findings, result.findings
+
+
 def test_duration_covers_parsing_not_just_checking():
     # the <5s budget must measure what an operator waits for: a full run
     # spends most of its time reading+parsing, which duration_s includes

@@ -2148,10 +2148,19 @@ def _bench_host_datapath(extras, smoke=False):
 
     The run doubles as the tracing demonstration (ISSUE 4): sampled
     per-frame tracing is enabled at 1/16 into a scratch spool for the
-    stream, and the resulting span summary + flight-recorder event
-    counts land in bench_full.json (``trace_summary`` /
+    request/response stream, and the resulting span summary + flight-
+    recorder event counts land in bench_full.json (``trace_summary`` /
     ``flight_events``) — the artifact proves the tracing path works on
     every bench run, and PERF_NOTES records its measured overhead.
+
+    ISSUE 5 adds a ``streaming`` row over the same frames: the consumer
+    drains the server-push stream (credit-window delivery, explicit
+    cumulative acks) instead of pulling — ``host_datapath_stream_*``
+    report its fps, copies/frame (still 1.00) and credit-window
+    occupancy from the ``stream`` obs gauges. On loopback the RTT the
+    stream hides is tiny, so the two rows should be close; the
+    RTT-independence acceptance (>=10x through a 5 ms delay proxy)
+    lives in tests/test_tcp_stream.py and PERF_NOTES.
     """
     import tempfile
     import threading as _threading
@@ -2161,7 +2170,7 @@ def _bench_host_datapath(extras, smoke=False):
     from psana_ray_tpu.obs.tracing import TRACER
     from psana_ray_tpu.records import EndOfStream, FrameRecord
     from psana_ray_tpu.transport import RingBuffer
-    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+    from psana_ray_tpu.transport.tcp import STREAM, TcpQueueClient, TcpQueueServer
     from psana_ray_tpu.utils.bufpool import BufferPool, WIRE
 
     shape = (2, 32, 32) if smoke else (16, 352, 384)  # epix10k2M u16
@@ -2169,60 +2178,80 @@ def _bench_host_datapath(extras, smoke=False):
     batch_size = 8 if smoke else 32
     rng = np.random.default_rng(7)
     pool16 = [rng.integers(0, 4096, size=shape, dtype=np.uint16) for _ in range(4)]
-
-    # queue depth bounds the pool's working set (every queued frame holds
-    # a pooled lease): one batch of headroom keeps the relay busy without
-    # ballooning retained buffers
-    srv = TcpQueueServer(RingBuffer(batch_size), host="127.0.0.1").serve_background()
-    prod = TcpQueueClient("127.0.0.1", srv.port)
-    cons = TcpQueueClient("127.0.0.1", srv.port)
     buf_pool = BufferPool.default()
 
-    # sampled tracing rides the same stream (scratch spool, 1-in-16):
-    # the bench artifact then carries a live span summary
+    def run_relay(streaming: bool):
+        """One producer->server->batched-consumer pass; returns the
+        measured (fps, copies/frame, allocs/frame, growth/frame, pool)."""
+        # queue depth bounds the pool's working set (every queued frame
+        # holds a pooled lease): one batch of headroom keeps the relay
+        # busy without ballooning retained buffers
+        srv = TcpQueueServer(
+            RingBuffer(batch_size), host="127.0.0.1"
+        ).serve_background()
+        prod = TcpQueueClient("127.0.0.1", srv.port)
+        cons = TcpQueueClient("127.0.0.1", srv.port)
+
+        def produce(warmup: int):
+            total = warmup + n_frames
+            for i in range(total):
+                rec = FrameRecord(
+                    0, i, pool16[i % 4], 9.5, trace=TRACER.maybe_trace()
+                )
+                if not prod.put_wait(rec, timeout=120.0):
+                    raise RuntimeError("producer starved out")
+            if not prod.put_wait(EndOfStream(total_events=total), timeout=120.0):
+                raise RuntimeError("EOS delivery timed out")
+
+        try:
+            warmup = 3 * batch_size  # let the pool reach its working-set peak
+            t = _threading.Thread(target=produce, args=(warmup,), daemon=True)
+            seen = 0
+            t0 = time.perf_counter()
+            m0 = None
+            # copies are exactly per-frame, so count them over the WHOLE
+            # stream (a steady-state mark would land mid-pop: the batch
+            # source copies a pop's frames before yielding, skewing a
+            # windowed ratio); allocs genuinely need the steady window
+            c0 = WIRE.stats()
+            t.start()
+            for batch in batches_from_queue(
+                cons, batch_size, poll_interval_s=0.001, prefer_stream=streaming
+            ):
+                seen += batch.num_valid
+                if m0 is None and seen >= warmup:  # steady state begins
+                    m0 = buf_pool.stats()
+                    t0 = time.perf_counter()
+                    seen_at_mark = seen
+            dt = time.perf_counter() - t0
+            t.join()
+            if m0 is None:  # stream died before steady state: no number
+                raise RuntimeError(
+                    f"only {seen} frames before EOS; no steady window"
+                )
+            c1, m1 = WIRE.stats(), buf_pool.stats()
+            steady = max(1, seen - seen_at_mark)
+            fps = steady / dt
+            copies = (c1["copies_total"] - c0["copies_total"]) / max(1, seen)
+            # steady-state churn only: a miss that raised the class's
+            # concurrency high-water is working-set growth (those buffers
+            # never existed before), not a per-frame allocation
+            allocs = (m1["churn_misses"] - m0["churn_misses"]) / steady
+            growth = (m1["misses"] - m0["misses"]) / steady
+            return fps, copies, allocs, growth, m1
+        finally:
+            for c in (prod, cons):
+                try:
+                    c.disconnect()
+                except Exception:
+                    pass
+            srv.shutdown()
+
+    # -- request/response row (doubles as the tracing demo) ---------------
     trace_dir = tempfile.mkdtemp(prefix="bench_trace_")
     TRACER.configure(trace_dir, sample_every=16, process="bench")
-
-    def produce(warmup: int):
-        total = warmup + n_frames
-        for i in range(total):
-            rec = FrameRecord(0, i, pool16[i % 4], 9.5, trace=TRACER.maybe_trace())
-            if not prod.put_wait(rec, timeout=120.0):
-                raise RuntimeError("producer starved out")
-        if not prod.put_wait(EndOfStream(total_events=total), timeout=120.0):
-            raise RuntimeError("EOS delivery timed out")
-
     try:
-        warmup = 3 * batch_size  # let the pool reach its working-set peak
-        t = _threading.Thread(target=produce, args=(warmup,), daemon=True)
-        seen = 0
-        t0 = time.perf_counter()
-        m0 = None
-        # copies are exactly per-frame, so count them over the WHOLE
-        # stream (a steady-state mark would land mid-pop: the batch
-        # source copies a pop's frames before yielding, skewing a
-        # windowed ratio); allocs genuinely need the steady window
-        c0 = WIRE.stats()
-        t.start()
-        for batch in batches_from_queue(cons, batch_size, poll_interval_s=0.001):
-            seen += batch.num_valid
-            if m0 is None and seen >= warmup:  # steady state begins
-                m0 = buf_pool.stats()
-                t0 = time.perf_counter()
-                seen_at_mark = seen
-        dt = time.perf_counter() - t0
-        t.join()
-        if m0 is None:  # stream died before steady state: no number
-            raise RuntimeError(f"only {seen} frames before EOS; no steady window")
-        c1, m1 = WIRE.stats(), buf_pool.stats()
-        steady = max(1, seen - seen_at_mark)
-        fps = steady / dt
-        copies = (c1["copies_total"] - c0["copies_total"]) / max(1, seen)
-        # steady-state churn only: a miss that raised the class's
-        # concurrency high-water is working-set growth (those buffers
-        # never existed before), not a per-frame allocation
-        allocs = (m1["churn_misses"] - m0["churn_misses"]) / steady
-        growth = (m1["misses"] - m0["misses"]) / steady
+        fps, copies, allocs, growth, m1 = run_relay(streaming=False)
         extras["host_datapath_tcp_fps"] = round(fps, 1)
         extras["host_datapath_copies_per_frame"] = round(copies, 3)
         extras["host_datapath_allocs_per_frame"] = round(allocs, 3)
@@ -2250,12 +2279,29 @@ def _bench_host_datapath(extras, smoke=False):
         import shutil
 
         shutil.rmtree(trace_dir, ignore_errors=True)  # scratch spool
-        for c in (prod, cons):
-            try:
-                c.disconnect()
-            except Exception:
-                pass
-        srv.shutdown()
+
+    # -- streaming row (ISSUE 5: server-push, credit-window delivery) ------
+    s0 = STREAM.stats()
+    fps_s, copies_s, allocs_s, growth_s, _ = run_relay(streaming=True)
+    s1 = STREAM.stats()
+    occupancy = {
+        "window": s1["credit_window"] or None,  # 0 after clean close
+        "inflight_peak": s1["inflight_peak"],
+        "frames_pushed": s1["frames_pushed_total"] - s0["frames_pushed_total"],
+        "acks": s1["acks_total"] - s0["acks_total"],
+        "redelivered": s1["redelivered_total"] - s0["redelivered_total"],
+    }
+    extras["host_datapath_stream_fps"] = round(fps_s, 1)
+    extras["host_datapath_stream_copies_per_frame"] = round(copies_s, 3)
+    extras["host_datapath_stream_allocs_per_frame"] = round(allocs_s, 3)
+    extras["host_datapath_stream_occupancy"] = occupancy
+    log(
+        f"host datapath [tcp STREAMING, u16 {shape}]: {fps_s:.0f} fps, "
+        f"{copies_s:.2f} copies/frame, {allocs_s:.3f} allocs/frame "
+        f"steady-state (window peak {occupancy['inflight_peak']} in "
+        f"flight, {occupancy['acks']} acks, "
+        f"{occupancy['redelivered']} redelivered)"
+    )
 
 
 def _bench_fanin_host(extras, smoke=False):

@@ -38,11 +38,24 @@ class DataReader:
         queue_name: Optional[str] = None,
         namespace: Optional[str] = None,
         config: Optional[TransportConfig] = None,
+        streaming: bool = False,
+        stream_window: int = 32,
     ):
+        """``streaming=True`` (TCP transports) subscribes the data
+        connection to server-push delivery with a ``stream_window``-frame
+        credit window (transport.tcp streaming contract): ``read_wait``/
+        ``read_batch``/``iter_records`` then drain pushed frames with no
+        per-read round trip and no empty-queue polling — the pull RTT
+        disappears and the credit window bounds client memory like a
+        prefetch depth. Delivery stays at-least-once: frames this reader
+        consumed-but-not-yet-acked redeliver to another consumer on a
+        crash. Ignored (plain reads) on transports without streaming."""
         self.config = config or TransportConfig()
         self.address = address if address != "auto" else self.config.address
         self.queue_name = queue_name or self.config.queue_name
         self.namespace = namespace or self.config.namespace
+        self.streaming = streaming
+        self.stream_window = stream_window
         self._queue = None
 
     # -- lifecycle (parity: data_reader.py:11-29,39-44) -------------------
@@ -63,6 +76,11 @@ class DataReader:
             self._queue = self._open()
         except RendezvousTimeout as e:
             raise DataReaderError(f"could not find queue {self.queue_name!r}: {e}") from e
+        if self.streaming and hasattr(self._queue, "stream_open"):
+            try:
+                self._queue.stream_open(self.stream_window)
+            except TransportClosed as e:
+                raise DataReaderError(str(e)) from e
         return self
 
     def close(self):
@@ -196,6 +214,19 @@ def main(argv=None):
     p.add_argument("--ray_address", "--address", dest="address", default="auto")
     p.add_argument("--ray_namespace", "--namespace", dest="namespace", default="default")
     p.add_argument("--queue_name", default="shared_queue")
+    p.add_argument(
+        "--stream", action="store_true",
+        help="subscribe the data connection to server-push streaming "
+        "(TCP transports): frames are pushed as they arrive under a "
+        "credit window instead of pulled one round trip at a time — "
+        "RTT-independent throughput, same at-least-once redelivery",
+    )
+    p.add_argument(
+        "--stream_window", type=int, default=32,
+        help="streaming credit window (frames in flight before the "
+        "server blocks on this consumer's acks); bounds consumer-side "
+        "memory like a prefetch depth",
+    )
     p.add_argument("--max_frames", type=int, default=None)
     p.add_argument("--quiet", action="store_true", help="suppress per-frame lines")
     p.add_argument("--log_level", default="INFO")
@@ -310,7 +341,8 @@ def main(argv=None):
     monitor = None
     try:
         with trace(a.profile_dir), DataReader(
-            address=a.address, queue_name=a.queue_name, namespace=a.namespace
+            address=a.address, queue_name=a.queue_name, namespace=a.namespace,
+            streaming=a.stream, stream_window=a.stream_window,
         ) as reader:
             if observe_dwell or a.trace_dir:
                 # depth in the heartbeat — over a DEDICATED handle, never

@@ -234,12 +234,20 @@ def batches_from_queue(
     stop=None,
     n_buffers: int = 0,
     raise_on_stall: bool = False,
+    prefer_stream: bool = True,
 ) -> Iterator[Batch]:
     """Drain a transport queue into fixed-shape batches until EOS.
 
     Uses ``get_batch`` (one lock acquisition for many items) rather than the
     reference's one-RPC-per-event read (``data_reader.py:35``). On stream
     completion the tail is flushed padded; iteration then stops.
+    When the transport offers a server-push stream drain
+    (``get_batch_stream`` — the TCP streaming mode, transport.tcp) it is
+    preferred: the server pushes frames under a credit window, so the
+    per-pop round trip and the empty-queue poll both disappear and
+    ``poll_interval_s`` only paces this loop's stop/stall checks
+    (``prefer_stream=False`` forces the request/response pull, e.g. for
+    A/B benchmarking).
     ``max_wait_s`` bounds total starvation (None = wait forever, matching
     the reference consumer loop); with ``raise_on_stall=True`` hitting it
     raises :class:`StreamStalled` (after yielding any pending tail) instead
@@ -258,11 +266,14 @@ def batches_from_queue(
     batcher: Optional[FrameBatcher] = None
     starved_since: Optional[float] = None
     tally = EosTally()
-    # zero-copy drain when the transport offers it (shm ring): records
-    # view transport memory and are copied+released per push below —
-    # copies/frame drops to exactly one. Pooled TCP clients return
-    # lease-backed records from plain get_batch already.
-    pop = getattr(queue, "get_batch_view", None) or queue.get_batch
+    # drain preference: server-push stream (TCP streaming mode — no pull
+    # RTT, no empty-queue polls) > zero-copy view drain (shm ring slots)
+    # > plain get_batch. Every TCP variant returns lease-backed records
+    # (pooled recv), so copies/frame stays at exactly the one batch-arena
+    # memcpy in push_view below.
+    pop = (getattr(queue, "get_batch_stream", None) if prefer_stream else None) or (
+        getattr(queue, "get_batch_view", None) or queue.get_batch
+    )
     try:
         while True:
             if stop is not None and stop.is_set():

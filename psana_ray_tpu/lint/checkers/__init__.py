@@ -14,6 +14,7 @@ from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
     leases,
     locks,
     names,
+    resend,
     threads,
     wire,
 )

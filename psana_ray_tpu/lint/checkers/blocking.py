@@ -18,10 +18,12 @@ deliberate scope cuts:
 - ``TcpQueueClient.*`` is excluded: every client wait threads an
   explicit ``deadline`` through ``_retrying``/``_reconnect`` (its own
   latency contract, reviewed in PR 1), which a name-based graph cannot
-  see past;
-- the ``pop = getattr(queue, "get_batch_view", ...)`` indirection in
-  ``batches_from_queue`` is restored with an explicit seed edge to the
-  transports' batch getters.
+  see past — but ``TcpStreamReader`` (the ISSUE 5 server-push drain the
+  batcher prefers) is NOT excluded: its reads must stay timeout-bounded
+  socket waits with no sleeps, and the checker audits that;
+- the ``pop = getattr(queue, "get_batch_stream"/"get_batch_view", ...)``
+  indirection in ``batches_from_queue`` is restored with explicit seed
+  edges to the transports' batch getters (stream, view, and plain).
 
 Banned inside the reachable set: ``time.sleep`` (scheduler hold with no
 transport deadline), bare ``.acquire()`` (lock wait with no timeout —
@@ -52,7 +54,9 @@ ROOTS = {
 }
 
 # bare-name edges the getattr() transport-preference indirection hides
-SEED_EDGES = {"batches_from_queue": ("get_batch", "get_batch_view")}
+SEED_EDGES = {
+    "batches_from_queue": ("get_batch", "get_batch_view", "get_batch_stream")
+}
 
 EXCLUDE_PREFIXES = ("TcpQueueClient.",)
 

@@ -40,34 +40,93 @@ logger = logging.getLogger(__name__)
 
 
 class _Sender:
-    """Backpressured frame sender; batches puts on transports that support
-    ``put_batch`` (TCP) so the cross-host path pays one round trip per N
-    frames instead of the reference's one RPC per event (``producer.py:
-    101``, SURVEY.md §3.1). In-process/shm puts are memcpys — those stay
-    per-event (batch size 1). Over TCP the batch leaves via ``sendmsg``
-    scatter-gather straight from each record's panel memory
-    (``FrameRecord.wire_parts``): a producer put performs ZERO payload
-    copies."""
+    """Backpressured frame sender, preferring the fastest path the
+    transport offers:
+
+    - **windowed pipelined PUT** (TCP, ``put_pipelined``): each record
+      goes out immediately, up to W sequence-numbered puts in flight
+      before blocking on acknowledgements — the link stays full instead
+      of paying one round trip per flush, backpressure arrives as
+      delayed acks from the server's blocking enqueue (no refusal/retry
+      spin), and a reconnect resends exactly the unacked tail;
+    - **batched puts** (``put_batch``): one round trip per N frames
+      (the pre-streaming TCP path, kept for transports without the
+      windowed opcode);
+    - per-event puts otherwise (in-process/shm — a put is a memcpy).
+
+    Over TCP every variant leaves via ``sendmsg`` scatter-gather
+    straight from each record's panel memory (``FrameRecord.
+    wire_parts``): a producer put performs ZERO payload copies."""
 
     def __init__(self, queue, backoff, stop_event, metrics, batch_size: int = 16):
         self.queue = queue
         self.backoff = backoff
         self.stop = stop_event
         self.metrics = metrics
-        self.batch_size = batch_size if hasattr(queue, "put_batch") else 1
+        self.windowed = hasattr(queue, "put_pipelined")
+        self.batch_size = (
+            batch_size if (not self.windowed and hasattr(queue, "put_batch")) else 1
+        )
         self.pending: List[FrameRecord] = []
 
     def send(self, rec) -> bool:
-        """Buffer + flush when full. False = transport closed/stopped."""
+        """Buffer + flush when full (windowed: ship immediately, blocking
+        only when the in-flight window is full). False = transport
+        closed/stopped."""
+        if self.windowed:
+            return self._send_windowed(rec)
         self.pending.append(rec)
         if len(self.pending) >= self.batch_size:
             return self.flush()
         return True
 
+    def _send_windowed(self, rec) -> bool:
+        t_try = time.monotonic()
+        if rec.hops is not None:
+            rec.hops[HOP_ENQ] = t_try
+        while not self.stop.is_set():
+            try:
+                # bounded slices so stop() stays responsive while the
+                # window is full (server blocked on a full queue)
+                if self.queue.put_pipelined(
+                    rec, deadline=time.monotonic() + 0.5
+                ):
+                    break
+            except TransportWedged:
+                raise  # a crashed peer wedged the ring: error, not clean exit
+            except TransportClosed:
+                return False
+        else:
+            return False
+        self.metrics.observe_frame(rec.nbytes)
+        h = rec.hops
+        if h is not None and HOP_SRC in h:
+            self.metrics.stages.observe(STAGE_ENQUEUE, t_try - h[HOP_SRC])
+        trace = rec.trace
+        if trace is not None and trace.sampled and TRACER.enabled:
+            t_src = h[HOP_SRC] if h and HOP_SRC in h else t_try
+            TRACER.instant(trace.trace_id, SPAN_PRODUCE, t_src)
+            TRACER.span(trace.trace_id, STAGE_ENQUEUE, t_src, t_try)
+        return True
+
     def flush(self) -> bool:
         """Drain the buffer with the backpressure envelope (parity:
-        producer.py:106-111). False = transport closed/stopped (records
-        may remain pending — the stream is dead either way)."""
+        producer.py:106-111). Windowed: block until every in-flight put
+        is acknowledged (the durability point before EOS/barrier).
+        False = transport closed/stopped (records may remain pending —
+        the stream is dead either way)."""
+        if self.windowed:
+            while not self.stop.is_set():
+                try:
+                    if self.queue.flush_puts(
+                        deadline=time.monotonic() + 0.5
+                    ):
+                        return True
+                except TransportWedged:
+                    raise
+                except TransportClosed:
+                    return False
+            return False
         while self.pending:
             if self.stop.is_set():
                 return False

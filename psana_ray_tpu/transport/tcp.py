@@ -12,7 +12,23 @@ object-store copy.
 Wire protocol (all little-endian):
     request:  op:u8 ('P'|'G'|'S'|'C') + [P only] len:u32 + payload
               'B' (get-batch) + max_items:u32
+              'D' (get-batch, bounded server-side wait) + max_items:u32
+                  + timeout_ms:u32 — the server blocks up to the timeout
+                  (capped at ``_SERVER_WAIT_CAP_S``) for the FIRST item,
+                  so a momentarily empty queue costs one round trip per
+                  cap interval instead of one per client poll tick
               'Q' (put-batch) + count:u32 + count x (len:u32 + payload)
+              'U' (put, bounded server-side wait) + timeout_ms:u32
+                  + len:u32 + payload — the server blocks for queue
+                  space up to the (capped) timeout before answering
+                  '1'/'0', the producer-side mirror of 'D'
+              'W' (windowed put) + seq:u64 + len:u32 + payload —
+                  pipelined: the client does NOT wait for the response
+                  before the next request; see streaming contract below
+              'M' (stream subscribe) + credits:u32 — switch this
+                  connection to server-push delivery; see below
+              'K' (stream ack) + seq:u64 — cumulative consumption ack
+                  on a streamed connection (credit replenish)
               'O' (open) + ns_len:u16 + ns + name_len:u16 + name
                          + maxsize:u32
               'T' (stats) — queue-health RPC: depth, high-water mark,
@@ -27,24 +43,55 @@ Wire protocol (all little-endian):
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
               + [G ok] len:u32 + payload   + [S] size:u32
-              + [B ok] count:u32 + count x (len:u32 + payload)
+              + [B/D ok] count:u32 + count x (len:u32 + payload)
               + [Q ok] accepted:u32
+              + [W ok] seq:u64 (the acknowledged put's sequence number)
               + [T ok] len:u32 + JSON stats object
               + [A ok] wall:f64 + mono:f64
+    stream push (server -> client, after 'M'):
+              status:u8 ('1') + seq:u64 + len:u32 + payload per frame;
+              'X' when the bound queue closes (the stream is over)
 
 Delivery contract (PART OF THE WIRE PROTOCOL, not a server detail): the
-server holds each GET/B delivery as in-flight until the SAME connection's
-next opcode arrives (implicit ACK — a client can only send its next
-request after fully reading the previous response) or BYE acks it on
-clean disconnect. This assumes ONE outstanding request per connection: a
-pipelining client that sends request N+1 before reading response N would
-silently forfeit in-flight protection (the early opcode acks a delivery
-the client has not read). Duplicates are therefore possible on crash/
-retry (at-least-once), silent loss is not. Duplicated control records are
-benign: EndOfStream markers tally idempotently (coverage is keyed by
-``producer_rank`` — :class:`psana_ray_tpu.records.EosTally`), and
-FrameRecord duplicates carry their ``(shard_rank, event_idx)`` provenance
-for downstream dedup.
+server holds each GET/B/D delivery as in-flight until the SAME
+connection's next opcode arrives (implicit ACK — a client can only send
+its next request after fully reading the previous response) or BYE acks
+it on clean disconnect. This assumes ONE outstanding request per
+connection: a pipelining client that sends request N+1 before reading
+response N would silently forfeit in-flight protection (the early opcode
+acks a delivery the client has not read). Duplicates are therefore
+possible on crash/retry (at-least-once), silent loss is not. Duplicated
+control records are benign: EndOfStream markers tally idempotently
+(coverage is keyed by ``producer_rank`` —
+:class:`psana_ray_tpu.records.EosTally`), and FrameRecord duplicates
+carry their ``(shard_rank, event_idx)`` provenance for downstream dedup.
+
+Streaming contract (ISSUE 5): the request/response exchange above pays
+one full RTT per round trip under exactly one outstanding request, so on
+any real link throughput is RTT-bound (~1/RTT frames/s/connection at
+queue-limited batch sizes). Two connection modes deliberately REPLACE
+the implicit next-request ACK with explicit sequence/credit ACKs so the
+link can stay full of in-flight work:
+
+- ``STREAM`` ('M'): the client subscribes with an initial credit count
+  W; the server pushes queued frames as they arrive — scatter-gather,
+  straight from the queued record's pooled lease — tagging each with a
+  per-connection sequence number and decrementing credits, and blocks
+  once W pushes are unacknowledged. The client replenishes credits with
+  cumulative 'K' acks as it CONSUMES (it acks everything previously
+  returned when it comes back for more — the same point the implicit
+  ACK fired in request/response mode), so the credit window bounds
+  client-side memory exactly like a prefetch depth. Pushed-but-unacked
+  frames are held server-side and RE-ENQUEUED (head placement) when the
+  connection dies — at-least-once crash-redelivery, exactly as
+  in-flight GETs. A streamed connection carries ONLY pushes downstream
+  and 'K'/'F' upstream; any other opcode on it is a protocol error.
+- windowed PUT ('W'): up to W sequence-numbered puts in flight before
+  the client blocks reading statuses. The server enqueues each (waiting
+  for space — backpressure arrives as delayed acks) and answers
+  '1'+seq. On reconnect the client resends the entire unacknowledged
+  tail, in order, before anything else touches the fresh connection —
+  duplicates possible, holes never.
 
 Client threading: :class:`TcpQueueClient` serializes every exchange under
 one lock, satisfying the one-outstanding-request rule; during an outage a
@@ -97,6 +144,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, List, Optional
 
 from psana_ray_tpu.obs.flight import FLIGHT
@@ -118,7 +166,12 @@ _OP_GET = b"G"
 _OP_SIZE = b"S"
 _OP_CLOSE = b"C"
 _OP_GET_BATCH = b"B"
+_OP_GET_BATCH_WAIT = b"D"
 _OP_PUT_BATCH = b"Q"
+_OP_PUT_WAIT = b"U"
+_OP_PUT_SEQ = b"W"
+_OP_STREAM = b"M"
+_OP_STREAM_ACK = b"K"
 _OP_OPEN = b"O"
 _OP_STATS = b"T"
 _OP_ANCHOR = b"A"
@@ -127,6 +180,114 @@ _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
 _ST_ERR = b"E"
+
+# The longest one bounded-wait request ('D'/'U' timeout field, one
+# windowed-put enqueue attempt, one stream pop) may hold a serve thread:
+# long enough that an idle consumer costs ~one round trip per interval,
+# short enough that drain/shutdown and connection-death detection stay
+# timely.
+_SERVER_WAIT_CAP_S = 2.0
+# stream push loop: queue-pop granularity while credits are available
+_STREAM_POP_TIMEOUT_S = 0.25
+# default credit window (frames in flight) for stream subscriptions and
+# the windowed-put pipeline — bounds client memory like a prefetch depth
+DEFAULT_STREAM_WINDOW = 32
+
+
+class StreamTelemetry:
+    """Credit/in-flight-window accounting for the streaming transport
+    (obs source ``stream``): how full the credit windows run, how much
+    sits unacknowledged, and how often crash-redelivery fired. One
+    process-wide instance (:data:`STREAM`), registered in the default
+    MetricsRegistry on first streaming use."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registered = False  # guarded-by: _lock
+        self.streams_opened = 0  # guarded-by: _lock
+        self.frames_pushed = 0  # guarded-by: _lock
+        self.acks = 0  # ack messages seen (client+server side)  # guarded-by: _lock
+        self.redelivered = 0  # frames requeued off dead streams  # guarded-by: _lock
+        self.inflight = 0  # pushed-not-yet-acked, all server streams  # guarded-by: _lock
+        self.inflight_peak = 0  # guarded-by: _lock
+        self.credit_window = 0  # sum of active subscriptions' windows  # guarded-by: _lock
+        self.put_window_depth = 0  # client-side unacked windowed puts  # guarded-by: _lock
+        self.put_window_peak = 0  # guarded-by: _lock
+        self.put_resent = 0  # windowed puts resent after reconnect  # guarded-by: _lock
+
+    def ensure_registered(self):
+        with self._lock:
+            if self._registered:
+                return
+            self._registered = True
+        try:
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().register("stream", self)
+        except Exception:  # obs optional: transport must work without it
+            pass
+
+    def opened(self, window: int):
+        self.ensure_registered()
+        with self._lock:
+            self.streams_opened += 1
+            self.credit_window += window
+
+    def closed(self, window: int):
+        with self._lock:
+            self.credit_window -= window
+
+    def pushed(self, n: int):
+        with self._lock:
+            self.frames_pushed += n
+            self.inflight += n
+            if self.inflight > self.inflight_peak:
+                self.inflight_peak = self.inflight
+
+    def pruned(self, n: int):
+        with self._lock:
+            self.inflight -= n
+
+    def acked_msg(self):
+        with self._lock:
+            self.acks += 1
+
+    def redelivered_n(self, n: int):
+        with self._lock:
+            self.redelivered += n
+
+    def put_depth(self, depth: int):
+        self.ensure_registered()
+        with self._lock:
+            self.put_window_depth = depth
+            if depth > self.put_window_peak:
+                self.put_window_peak = depth
+
+    def resent(self, n: int):
+        with self._lock:
+            self.put_resent += n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "streams_opened": self.streams_opened,
+                "frames_pushed_total": self.frames_pushed,
+                "acks_total": self.acks,
+                "redelivered_total": self.redelivered,
+                "inflight": self.inflight,
+                "inflight_peak": self.inflight_peak,
+                "credit_window": self.credit_window,
+                "put_window_depth": self.put_window_depth,
+                "put_window_peak": self.put_window_peak,
+                "put_resent_total": self.put_resent,
+            }
+
+    # obs registry source protocol
+    def snapshot(self) -> dict:
+        return self.stats()
+
+
+STREAM = StreamTelemetry()
 
 
 
@@ -249,6 +410,24 @@ def _recv_payload(sock: socket.socket, n: int, pool: BufferPool):
     except BaseException:
         lease.release()  # idempotent: double-release after decode is safe
         raise
+
+
+def _peer_hung_up(conn: socket.socket) -> bool:
+    """Non-destructive liveness probe for a connection we are NOT
+    currently reading: True when the peer closed (orderly FIN) or reset.
+    Bytes waiting (a pipelined client's next request) mean alive — they
+    are left in place (MSG_PEEK). Used by server-side blocking enqueues
+    so backpressure never pins a serve thread to a dead client."""
+    try:
+        conn.setblocking(False)
+        try:
+            return conn.recv(1, socket.MSG_PEEK) == b""
+        except (BlockingIOError, InterruptedError):
+            return False  # nothing to read: peer alive, just quiet
+        finally:
+            conn.setblocking(True)
+    except OSError:
+        return True
 
 
 def _send_response_payload(conn: socket.socket, item) -> None:
@@ -449,6 +628,128 @@ class TcpQueueServer:
             FLIGHT.record("requeue_in_flight", count=len(items))
         return_to_queue(queue, items, what="in-flight frame")
 
+    def _send_batch_response(self, conn: socket.socket, items) -> List[Any]:
+        """One ``status + count + count x (len + payload)`` response
+        ('B'/'D'), scatter-gather; returns the delivered items (the
+        caller's in-flight set) after emitting relay spans."""
+        in_flight = list(items)
+        parts = [_ST_OK, struct.pack("<I", len(in_flight))]
+        for item in in_flight:
+            item_parts = _encode_parts(item)
+            parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
+            parts.extend(item_parts)
+        t_send0 = time.monotonic() if TRACER.enabled else 0.0
+        _sendmsg_all(conn, parts)
+        if TRACER.enabled:
+            _emit_relay_spans(in_flight, t_send0)
+        return in_flight
+
+    def _serve_stream(self, conn: socket.socket, queue, window: int):
+        """Server half of stream mode (opcode 'M'): push queued frames as
+        they arrive, at most ``window`` unacknowledged; a reader thread
+        consumes the client's cumulative 'K' acks (credit replenish) and
+        'F' (clean unsubscribe). Pushed-but-unacked frames are re-enqueued
+        at the queue head when the connection ends — the streaming
+        equivalent of the request/response in-flight requeue, so crash
+        redelivery stays at-least-once (duplicates possible, loss never)."""
+        window = max(1, min(int(window), 4096))
+        STREAM.opened(window)
+        FLIGHT.record("stream_open", port=self.port, window=window)
+        cond = threading.Condition()
+        state = {"acked": 0, "bye": False, "dead": False}
+
+        def _read_acks():
+            try:
+                while True:
+                    op = _recv_exact(conn, 1)
+                    if op == _OP_STREAM_ACK:
+                        (seq,) = struct.unpack("<Q", _recv_exact(conn, 8))
+                        with cond:
+                            if seq > state["acked"]:
+                                state["acked"] = seq
+                                STREAM.acked_msg()
+                            cond.notify()
+                    elif op == _OP_BYE:
+                        with cond:
+                            state["bye"] = True
+                            cond.notify()
+                        return
+                    else:
+                        raise ConnectionError(
+                            f"bad opcode {op!r} on streamed connection"
+                        )
+            except (ConnectionError, OSError):
+                with cond:
+                    state["dead"] = True
+                    cond.notify()
+
+        reader = threading.Thread(
+            target=_read_acks, daemon=True, name="tcp-stream-acks"
+        )
+        reader.start()
+        seq = 0
+        unacked: deque = deque()  # (seq, item) in push order — redelivery tail
+        queue_closed = False
+        try:
+            while not self._stop.is_set():
+                with cond:
+                    while unacked and unacked[0][0] <= state["acked"]:
+                        unacked.popleft()  # credit returned: lease may free
+                        STREAM.pruned(1)
+                    if state["bye"] or state["dead"]:
+                        break
+                    budget = window - (seq - state["acked"])
+                    if budget <= 0:  # window full: wait for credits
+                        cond.wait(timeout=0.2)
+                        continue
+                try:
+                    items = queue.get_batch(
+                        min(budget, 64), timeout=_STREAM_POP_TIMEOUT_S
+                    )
+                except TransportClosed:
+                    queue_closed = True
+                    try:
+                        conn.sendall(_ST_CLOSED)  # the stream is over
+                    except OSError:
+                        pass
+                    break
+                if not items:
+                    continue
+                t_send0 = time.monotonic() if TRACER.enabled else 0.0
+                parts = []
+                for item in items:
+                    seq += 1
+                    unacked.append((seq, item))
+                    item_parts = _encode_parts(item)
+                    parts.append(
+                        _ST_OK
+                        + struct.pack("<QI", seq, _parts_nbytes(item_parts))
+                    )
+                    parts.extend(item_parts)
+                _sendmsg_all(conn, parts)
+                STREAM.pushed(len(items))
+                if TRACER.enabled:
+                    _emit_relay_spans(items, t_send0)
+        except (ConnectionError, OSError):
+            pass  # redelivery below
+        finally:
+            with cond:
+                while unacked and unacked[0][0] <= state["acked"]:
+                    unacked.popleft()
+                    STREAM.pruned(1)
+                clean = state["bye"]
+                lost = [item for (_s, item) in unacked]
+            if lost:
+                STREAM.pruned(len(lost))
+                if not queue_closed:
+                    STREAM.redelivered_n(len(lost))
+                    FLIGHT.record(
+                        "stream_redelivery", count=len(lost), clean_bye=clean
+                    )
+                    self._requeue(queue, lost)
+            STREAM.closed(window)
+            reader.join(timeout=2.0)
+
     def _serve_conn(self, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         queue = self.queue  # rebound by OPEN; default-queue back-compat
@@ -493,16 +794,68 @@ class TcpQueueServer:
                     elif op == _OP_GET_BATCH:
                         (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
                         items = queue.get_batch(min(max_items, 4096), timeout=0.0)
-                        in_flight = list(items)  # held until the next opcode
-                        parts = [_ST_OK, struct.pack("<I", len(items))]
-                        for item in items:
-                            item_parts = _encode_parts(item)
-                            parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
-                            parts.extend(item_parts)
-                        t_send0 = time.monotonic() if TRACER.enabled else 0.0
-                        _sendmsg_all(conn, parts)
+                        # held until the next opcode:
+                        in_flight = self._send_batch_response(conn, items)
+                    elif op == _OP_GET_BATCH_WAIT:
+                        # bounded server-side wait for the FIRST item: an
+                        # empty queue costs the client one round trip per
+                        # cap interval, not one per poll tick
+                        max_items, wait_ms = struct.unpack(
+                            "<II", _recv_exact(conn, 8)
+                        )
+                        items = queue.get_batch(
+                            min(max_items, 4096),
+                            timeout=min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S),
+                        )
+                        in_flight = self._send_batch_response(conn, items)
+                    elif op == _OP_PUT_WAIT:
+                        # bounded server-side wait for queue SPACE — the
+                        # producer-side mirror of 'D' (no 1 kHz retry
+                        # round trips against a full queue)
+                        wait_ms, n = struct.unpack("<II", _recv_exact(conn, 8))
+                        item = _recv_payload(conn, n, self._pool)
                         if TRACER.enabled:
-                            _emit_relay_spans(in_flight, t_send0)
+                            _stamp_relay_arrival(item)
+                        if self._draining:
+                            conn.sendall(_ST_CLOSED)
+                            continue
+                        ok = queue.put_wait(
+                            item, timeout=min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S)
+                        )
+                        conn.sendall(_ST_OK if ok else _ST_NO)
+                    elif op == _OP_PUT_SEQ:
+                        # windowed pipelined put: enqueue (waiting for
+                        # space — backpressure reaches the client as a
+                        # delayed ack) and echo the sequence number. The
+                        # client reads acks lazily, up to W in flight.
+                        seq, n = struct.unpack("<QI", _recv_exact(conn, 12))
+                        item = _recv_payload(conn, n, self._pool)
+                        if TRACER.enabled:
+                            _stamp_relay_arrival(item)
+                        if self._draining:
+                            conn.sendall(_ST_CLOSED)
+                            continue
+                        accepted = False
+                        while not self._stop.is_set():
+                            if queue.put_wait(item, timeout=0.5):
+                                accepted = True
+                                break
+                            # the enqueue wait can outlive any timeout
+                            # (that IS the backpressure), so probe the
+                            # peer between slices: a dead client must
+                            # not pin this thread + the frame's lease
+                            # forever, and its frame must not enqueue
+                            # arbitrarily late on top of the reconnect
+                            # resend (the un-acked put redelivers there)
+                            if _peer_hung_up(conn):
+                                return
+                        if not accepted:
+                            return  # server stopping mid-window: client resends
+                        conn.sendall(_ST_OK + struct.pack("<Q", seq))
+                    elif op == _OP_STREAM:
+                        (window,) = struct.unpack("<I", _recv_exact(conn, 4))
+                        self._serve_stream(conn, queue, window)
+                        return  # the stream consumed the connection
                     elif op == _OP_PUT_BATCH:
                         # read the WHOLE request before touching the queue:
                         # an error mid-put (closed transport) must not leave
@@ -631,6 +984,7 @@ class TcpQueueClient:
         reconnect_tries: int = 4,
         reconnect_base_s: float = 0.5,
         pool: Optional[BufferPool] = None,
+        put_window: int = DEFAULT_STREAM_WINDOW,
     ):
         self.host, self.port = host, port
         self._timeout_s = timeout_s
@@ -642,6 +996,17 @@ class TcpQueueClient:
         self._reconnect_base_s = reconnect_base_s
         self._binding: Optional[tuple] = None  # (ns, name, maxsize) to replay
         self._lock = threading.Lock()
+        # streaming / windowed-put state — initialized BEFORE the dial so
+        # _reconnect (reachable from __init__) can consult it safely.
+        # _stream: once subscribed, this connection carries only pushes
+        # and acks; request/response ops route to a lazy side channel.
+        self._stream: Optional["TcpStreamReader"] = None
+        self._side: Optional["TcpQueueClient"] = None
+        # windowed pipelined PUT: monotonically numbered, unacked tail
+        # kept for resend-on-reconnect (duplicates possible, holes never)
+        self._put_seq = 0  # guarded-by: _lock
+        self._put_unacked: deque = deque()  # (seq, item)  # guarded-by: _lock
+        self._put_window = max(1, int(put_window))
         # the INITIAL dial goes through the same backoff machinery as
         # mid-stream drops: a consumer starting while the server is mid-
         # restart under a supervisor must wait it out, not crash with a
@@ -685,7 +1050,9 @@ class TcpQueueClient:
         ``deadline`` (time.monotonic()) passes, so timeout-bearing callers
         (get_wait/put_wait/get_batch) keep their latency contract instead
         of blocking through the full backoff cycle. Caller holds
-        ``self._lock`` (except from __init__, where no peer exists yet)."""
+        ``self._lock`` (except from __init__, where no peer exists yet
+        and the windowed/stream state is still empty)."""
+        # guarded-by-caller: _lock
         import time
 
         # flight-recorder breadcrumb: reconnect storms are the leading
@@ -723,6 +1090,23 @@ class TcpQueueClient:
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._binding is not None:
                     self._open_raw(*self._binding)
+                # windowed-put resend invariant: the entire unacked tail
+                # goes out FIRST, in sequence order, before any new
+                # request touches the fresh connection — the server may
+                # see duplicates (at-least-once) but never a hole
+                if self._put_unacked:
+                    self._resend_put_window()
+                # a streamed connection re-subscribes with its original
+                # credit window; frames the dead connection had in the
+                # air were re-enqueued server-side and redeliver here
+                if self._stream is not None:
+                    self._sock.sendall(
+                        _OP_STREAM + struct.pack("<I", self._stream.window)
+                    )
+                    self._stream.reset_after_reconnect()
+                    FLIGHT.record(
+                        "stream_resubscribe", host=self.host, port=self.port
+                    )
                 return
             except (ConnectionError, socket.timeout, OSError) as e:
                 last = e
@@ -737,7 +1121,18 @@ class TcpQueueClient:
         """Run one request/response exchange; on a RAW socket failure,
         reconnect (bounded by ``deadline`` when given) and retry the
         exchange once. TransportClosed from ``_status`` (server's explicit
-        refusal) passes straight through. Caller holds ``self._lock``."""
+        refusal) passes straight through. Caller holds ``self._lock``.
+
+        Pending windowed-put acks are fully drained FIRST: their
+        responses precede this exchange's in the byte stream, so a
+        request issued over an outstanding window would read a put ack
+        as its own status and desync the connection."""
+        # guarded-by-caller: _lock
+        if self._put_unacked and not self._drain_put_acks(0, deadline):
+            raise TransportClosed(
+                f"windowed puts to {self.host}:{self.port} still "
+                f"unacknowledged at the caller's deadline"
+            )
         try:
             return do()
         except (ConnectionError, socket.timeout, OSError) as e:
@@ -750,8 +1145,183 @@ class TcpQueueClient:
                     f"died again right after a successful reconnect: {e2}"
                 ) from e2
 
+    # -- windowed pipelined PUT (opcode 'W') ------------------------------
+    def _resend_put_window(self):
+        """Resend the whole unacknowledged tail on a fresh connection, in
+        sequence order (the windowed-put resend invariant — see the
+        module docstring's streaming contract). Called from _reconnect
+        with the new socket already dialed and the binding replayed."""
+        # guarded-by-caller: _lock
+        for seq, item in list(self._put_unacked):
+            parts = _encode_parts(item)
+            head = _OP_PUT_SEQ + struct.pack("<QI", seq, _parts_nbytes(parts))
+            _sendmsg_all(self._sock, [head, *parts])
+        n = len(self._put_unacked)
+        if n:
+            STREAM.resent(n)
+            FLIGHT.record(
+                "put_window_resend", count=n, host=self.host, port=self.port
+            )
+
+    def _drain_put_acks(self, max_unacked: int, deadline: Optional[float]) -> bool:
+        """Read windowed-put acks until at most ``max_unacked`` remain
+        in flight (False when ``deadline`` expires first — nothing is
+        lost; the tail stays queued for resend).
+
+        An OVERDUE ack is BACKPRESSURE, not death: the server delays
+        acks while its queue is full (the 'W' handler's blocking
+        enqueue), for arbitrarily long — so a quiet wire keeps waiting
+        in bounded slices instead of reconnecting (a reconnect here
+        would resend the whole window into the already-full queue:
+        duplicate amplification on every timeout, triggered by ordinary
+        backpressure). Only a broken connection (EOF/reset) reconnects
+        and resends, and that reconnect runs the FULL backoff envelope
+        regardless of ``deadline`` — a supervisor restart mid-window
+        must not kill the stream; the deadline bounds waiting, not
+        availability recovery. An explicit 'X' raises TransportClosed.
+        Caller holds ``self._lock``."""
+        # guarded-by-caller: _lock
+        while len(self._put_unacked) > max_unacked:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return False
+            slice_s = self._timeout_s
+            if remaining is not None:
+                slice_s = min(slice_s, remaining)
+            try:
+                # the ack-wait slice applies to the status byte only;
+                # once it arrives, the 8-byte seq follows at wire speed
+                # under the patient timeout (a timeout mid-ack would
+                # desync — that one IS treated as a raw failure)
+                try:
+                    self._sock.settimeout(slice_s)
+                    try:
+                        st = self._status()
+                    except socket.timeout:
+                        continue  # overdue = backpressured, keep waiting
+                finally:
+                    try:
+                        self._sock.settimeout(self._timeout_s)
+                    except OSError:
+                        pass
+                if st != _ST_OK:
+                    raise RuntimeError(
+                        f"protocol error in windowed-put ack: {st!r}"
+                    )
+                (seq,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+            except (ConnectionError, socket.timeout, OSError) as e:
+                self._reconnect(e)  # full envelope; resends the tail itself
+                continue
+            while self._put_unacked and self._put_unacked[0][0] <= seq:
+                self._put_unacked.popleft()
+            STREAM.put_depth(len(self._put_unacked))
+        return True
+
+    def put_pipelined(self, item: Any, deadline: Optional[float] = None) -> bool:
+        """Windowed pipelined put: send without waiting for the status,
+        keeping up to ``put_window`` sequence-numbered puts in flight
+        (backpressure arrives as delayed acks from the server's blocking
+        enqueue — no refusal/retry round trips). Returns False when the
+        window is still full at ``deadline`` (the item was NOT sent —
+        retry it); raises TransportClosed when the transport is dead
+        (``deadline`` bounds the wait for window space, NOT the
+        reconnect envelope — a supervisor restart mid-window rides the
+        full backoff like every other op). On reconnect the unacked
+        tail is resent: duplicates possible, holes never. Call
+        :meth:`flush_puts` before relying on durability (EOS,
+        shutdown)."""
+        if self._stream is not None:
+            return self._side_channel().put_pipelined(item, deadline)
+        parts = _encode_parts(item)
+        n = _parts_nbytes(parts)
+        if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
+            raise ValueError(
+                f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}"
+            )
+        with self._lock:
+            if not self._drain_put_acks(self._put_window - 1, deadline):
+                return False
+            self._put_seq += 1
+            seq = self._put_seq
+            self._put_unacked.append((seq, item))
+            STREAM.put_depth(len(self._put_unacked))
+            head = _OP_PUT_SEQ + struct.pack("<QI", seq, n)
+            try:
+                _sendmsg_all(self._sock, [head, *parts])
+            except (ConnectionError, socket.timeout, OSError) as e:
+                # full-envelope reconnect (no caller deadline: see the
+                # docstring) resends the whole tail — including this
+                # item, already appended above
+                self._reconnect(e)
+            return True
+
+    def flush_puts(self, deadline: Optional[float] = None) -> bool:
+        """Block until every windowed put is acknowledged (False when
+        ``deadline`` expires first; the tail stays in flight)."""
+        if self._stream is not None:
+            side = self._side
+            return True if side is None else side.flush_puts(deadline)
+        with self._lock:
+            return self._drain_put_acks(0, deadline)
+
+    # -- streaming consumption (opcodes 'M'/'K') --------------------------
+    def stream_open(self, window: int = DEFAULT_STREAM_WINDOW) -> "TcpStreamReader":
+        """Subscribe this connection to server-push delivery with an
+        initial credit count of ``window`` frames (idempotent — the
+        first subscription wins). From here on the connection carries
+        only pushes and acks: reads (get/get_wait/get_batch) drain the
+        stream, while puts/probes route over a lazily opened side
+        channel (see :meth:`_side_channel`)."""
+        with self._lock:
+            if self._stream is not None:
+                return self._stream
+            window = max(1, int(window))
+
+            def _do():
+                self._sock.sendall(_OP_STREAM + struct.pack("<I", window))
+
+            self._retrying(_do)
+            self._stream = TcpStreamReader(self, window)
+            STREAM.ensure_registered()
+            return self._stream
+
+    def get_batch_stream(
+        self, max_items: int, timeout: Optional[float] = None
+    ) -> List[Any]:
+        """Streamed drain (subscribing with the default credit window on
+        first use): returns whatever the server has already pushed, up
+        to ``max_items``, blocking at most ``timeout`` for the first
+        frame. The batcher prefers this entry point over ``get_batch``
+        — zero request round trips, zero empty-queue polls."""
+        return self.stream_open().get_batch_stream(max_items, timeout)
+
+    def _side_channel(self) -> "TcpQueueClient":
+        """A second plain connection for the rare request/response ops a
+        streamed client still needs (EOS duplicate put-backs, probes):
+        any such opcode on the streamed socket itself would desync the
+        push framing. Replays the named binding, shares the pool."""
+        side = self._side
+        if side is None:
+            ns, nm, ms = self._binding or (None, None, 0)
+            side = TcpQueueClient(
+                self.host,
+                self.port,
+                timeout_s=self._timeout_s,
+                namespace=ns,
+                queue_name=nm,
+                maxsize=ms,
+                reconnect_tries=self._reconnect_tries,
+                reconnect_base_s=self._reconnect_base_s,
+                pool=self._pool,
+                put_window=self._put_window,
+            )
+            self._side = side
+        return side
+
     # -- contract ---------------------------------------------------------
     def put(self, item: Any, deadline: Optional[float] = None) -> bool:
+        if self._stream is not None:  # streamed conn: puts use the side channel
+            return self._side_channel().put(item, deadline)
         # scatter-gather: the frame payload goes to the kernel straight
         # from the record's panel memory (wire_parts memoryview) — no
         # to_bytes() serialization copy, no request-assembly concat copy
@@ -769,6 +1339,9 @@ class TcpQueueClient:
             return self._retrying(_do, deadline)
 
     def get(self, deadline: Optional[float] = None) -> Any:
+        if self._stream is not None:  # drain already-pushed frames only
+            return self._stream.get_wait_stream(0.0)
+
         def _do():
             self._sock.sendall(_OP_GET)
             st = self._status()
@@ -790,6 +1363,9 @@ class TcpQueueClient:
     def size(self, deadline: Optional[float] = None) -> int:
         import time
 
+        if self._stream is not None:  # probes would desync the push framing
+            return self._side_channel().size(deadline)
+
         def _do():
             self._sock.sendall(_OP_SIZE)
             self._status()
@@ -807,6 +1383,8 @@ class TcpQueueClient:
         process's own samples, plus the measured RTT — exactly what
         :func:`psana_ray_tpu.obs.tracing.exchange_anchors` spools so the
         trace merge tool can align this host's clock to the server's."""
+        if self._stream is not None:
+            return self._side_channel().anchor(deadline)
 
         def _do():
             t0_wall, t0_mono = time.time(), time.monotonic()
@@ -837,6 +1415,9 @@ class TcpQueueClient:
         and the Prometheus endpoint read the same dict server-side)."""
         import time
 
+        if self._stream is not None:
+            return self._side_channel().stats(deadline)
+
         def _do():
             self._sock.sendall(_OP_STATS)
             self._status()
@@ -850,6 +1431,8 @@ class TcpQueueClient:
 
     def close_remote(self):
         """Close the remote queue (fault-injection / teardown)."""
+        if self._stream is not None:
+            return self._side_channel().close_remote()
 
         def _do():
             self._sock.sendall(_OP_CLOSE)
@@ -859,46 +1442,126 @@ class TcpQueueClient:
             return self._retrying(_do)
 
     # -- blocking helpers (same surface as RingBuffer) --------------------
+    # The surviving client-side sleeps below are deadline-checked every
+    # iteration and only run BETWEEN server-side bounded waits (the
+    # server already blocked _SERVER_WAIT_CAP_S for the condition), so
+    # total blocking is caller-bounded — the latency contract the
+    # blocking-hot-path lint checker's TcpQueueClient exclusion documents.
     def get_wait(self, timeout: Optional[float] = None, poll_s: float = 0.001) -> Any:
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
+        if self._stream is not None:  # streamed: the push IS the wait
+            while True:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return EMPTY
+                item = self._stream.get_wait_stream(remaining)
+                if item is not EMPTY:
+                    return item
+                if deadline is not None and time.monotonic() >= deadline:
+                    return EMPTY
         while True:
-            item = self.get(deadline)  # reconnects bounded by the deadline
-            if item is not EMPTY:
-                return item
+            # server-side bounded wait ('D', max_items=1): an empty queue
+            # costs one round trip per cap interval, not one per poll
+            out = self._get_batch_once(1, deadline, self._server_wait(deadline))
+            if out:
+                return out[0]
             if deadline is not None and time.monotonic() >= deadline:
                 return EMPTY
             time.sleep(poll_s)
 
-    def put_wait(self, item: Any, timeout: Optional[float] = None, poll_s: float = 0.001) -> bool:
+    @staticmethod
+    def _server_wait(deadline: Optional[float]) -> float:
+        """How long the SERVER should block for this round trip: the full
+        cap, clipped to the caller's remaining deadline."""
+        if deadline is None:
+            return _SERVER_WAIT_CAP_S
+        return min(_SERVER_WAIT_CAP_S, max(0.0, deadline - time.monotonic()))
+
+    def put_wait(
+        self, item: Any, timeout: Optional[float] = None, poll_s: float = 0.001
+    ) -> bool:
         import time
 
+        if self._stream is not None:
+            return self._side_channel().put_wait(item, timeout, poll_s)
+        parts = _encode_parts(item)
+        n = _parts_nbytes(parts)
+        if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
+            raise ValueError(
+                f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}"
+            )
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            if self.put(item, deadline):
-                return True
+            # server-side bounded wait for SPACE ('U'): a full queue costs
+            # one round trip per cap interval, not one rejected put per
+            # poll tick
+            wait_ms = int(self._server_wait(deadline) * 1000)
+            head = _OP_PUT_WAIT + struct.pack("<II", wait_ms, n)
+
+            def _do():
+                _sendmsg_all(self._sock, [head, *parts])
+                return self._status() == _ST_OK
+
+            with self._lock:
+                if self._retrying(_do, deadline):
+                    return True
             if deadline is not None and time.monotonic() >= deadline:
                 return False
             time.sleep(poll_s)
 
-    def get_batch(self, max_items: int, timeout: Optional[float] = None) -> List[Any]:
-        """Drain up to ``max_items`` in ONE round trip (opcode 'B'); polls
-        until ``timeout`` when the remote queue is momentarily empty."""
+    def get_batch(
+        self,
+        max_items: int,
+        timeout: Optional[float] = None,
+        poll_s: float = 0.001,
+    ) -> List[Any]:
+        """Drain up to ``max_items`` in ONE round trip; when the remote
+        queue is momentarily empty the SERVER blocks for the first item
+        (opcode 'D', bounded by ``timeout`` and the server cap), with
+        ``poll_s`` pacing retries between bounded waits."""
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
+        if self._stream is not None:
+            while True:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return []
+                out = self._stream.get_batch_stream(max_items, remaining)
+                if out:
+                    return out
+                if deadline is not None and time.monotonic() >= deadline:
+                    return []
         while True:
-            out = self._get_batch_once(max_items, deadline)
+            out = self._get_batch_once(
+                max_items, deadline, self._server_wait(deadline)
+            )
             if out:
                 return out
             if deadline is not None and time.monotonic() >= deadline:
                 return []
-            time.sleep(0.001)
+            time.sleep(poll_s)
 
-    def _get_batch_once(self, max_items: int, deadline: Optional[float] = None) -> List[Any]:
+    def _get_batch_once(
+        self,
+        max_items: int,
+        deadline: Optional[float] = None,
+        server_wait_s: float = 0.0,
+    ) -> List[Any]:
         def _do():
-            self._sock.sendall(_OP_GET_BATCH + struct.pack("<I", max_items))
+            if server_wait_s > 0:
+                self._sock.sendall(
+                    _OP_GET_BATCH_WAIT
+                    + struct.pack("<II", max_items, int(server_wait_s * 1000))
+                )
+            else:
+                self._sock.sendall(_OP_GET_BATCH + struct.pack("<I", max_items))
             self._status()
             (count,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             out = []
@@ -934,16 +1597,28 @@ class TcpQueueClient:
             return self._retrying(_do)
 
     def disconnect(self):
+        side, self._side = self._side, None
+        if side is not None:
+            side.disconnect()
         sock = getattr(self, "_sock", None)  # absent if the first dial failed
         if sock is None:
             return
         # BYE acks the last response: without it the server would treat
         # the close as a mid-delivery death and re-enqueue (duplicate) the
-        # last frame this client already consumed
+        # last frame this client already consumed. A windowed-put tail is
+        # drained first (bounded — this is teardown, not delivery), and a
+        # streamed connection sends its final cumulative ack so consumed
+        # frames are not redelivered to a sibling.
         try:
             with self._lock:
+                if self._put_unacked:
+                    self._drain_put_acks(
+                        0, time.monotonic() + self.PROBE_DEADLINE_S
+                    )
+                if self._stream is not None:
+                    self._stream.ack_consumed()
                 sock.sendall(_OP_BYE)
-        except OSError:
+        except (OSError, TransportClosed):
             pass
         try:
             sock.close()
@@ -957,3 +1632,124 @@ class TcpQueueClient:
         if st == _ST_ERR:
             raise RuntimeError("protocol error")
         return st
+
+
+class TcpStreamReader:
+    """Client half of stream mode: reads server-pushed frames off a
+    subscribed :class:`TcpQueueClient` connection and replenishes
+    credits with cumulative acks AS IT CONSUMES — a frame is acked when
+    the caller comes back for the next one, the exact point the
+    request/response mode took its implicit ACK, so crash-redelivery
+    granularity is unchanged (frames returned-but-unacked redeliver to
+    another consumer; duplicates possible, loss never).
+
+    Deliberately a separate class from TcpQueueClient: the blocking-
+    hot-path lint checker audits everything reachable from the batcher
+    drain loop, and this is that path (the client class itself is
+    excluded as deadline-audited). Every READ here is bounded by the
+    caller's timeout or the client's socket timeout, and there are no
+    sleeps. The one wait that deliberately exceeds a read timeout is a
+    mid-stream RECONNECT: it runs the client's full backoff envelope
+    (bounded by reconnect_tries x (backoff + dial timeout), NOT by the
+    read's pacing timeout) because a streamed subscription is a
+    long-lived attachment — bounding recovery by a 10 ms poll-pacing
+    timeout would turn every server restart into a consumer exit. All
+    methods run under the owning client's lock; probes that must not
+    wait behind it use their own connections (DataReader.open_monitor)."""
+
+    def __init__(self, client: TcpQueueClient, window: int):
+        self._c = client
+        self.window = window
+        self.delivered_seq = 0  # last seq returned to the caller
+        self.acked_seq = 0  # last seq cumulatively acked to the server
+        self._dead: Optional[str] = None  # 'X' seen: the stream is over
+
+    def reset_after_reconnect(self):
+        """The server assigns sequence numbers per connection: a fresh
+        subscription restarts at 1, and anything the dead connection had
+        unacked was re-enqueued server-side (it redelivers here)."""
+        self.delivered_seq = 0
+        self.acked_seq = 0
+
+    # -- protocol primitives (caller holds the client lock) ---------------
+    def ack_consumed(self):
+        """Cumulative credit replenish for everything already returned."""
+        if self.delivered_seq > self.acked_seq:
+            self._c._sock.sendall(
+                _OP_STREAM_ACK + struct.pack("<Q", self.delivered_seq)
+            )
+            self.acked_seq = self.delivered_seq
+            STREAM.acked_msg()
+
+    def _read_push(self, first_timeout: Optional[float]):
+        """One pushed frame, or EMPTY when no push arrives within
+        ``first_timeout`` (0 = only take what is already buffered). The
+        timeout applies to the leading status byte alone; once a push
+        has started, the remainder is read under the client's patient
+        timeout (a timeout mid-message would desync the framing)."""
+        if self._dead is not None:
+            raise TransportClosed(self._dead)
+        sock = self._c._sock
+        try:
+            sock.settimeout(first_timeout)  # 0 -> non-blocking probe
+            try:
+                st = _recv_exact(sock, 1)
+            except (BlockingIOError, socket.timeout):
+                return EMPTY
+        finally:
+            try:
+                sock.settimeout(self._c._timeout_s)
+            except OSError:
+                pass
+        if st == _ST_CLOSED:
+            self._dead = (
+                f"remote queue at {self._c.host}:{self._c.port} is closed"
+            )
+            raise TransportClosed(self._dead)
+        if st != _ST_OK:
+            raise RuntimeError(
+                f"protocol error on streamed connection: {st!r}"
+            )
+        seq, n = struct.unpack("<QI", _recv_exact(sock, 12))
+        item = _recv_payload(sock, n, self._c._pool)
+        self.delivered_seq = seq
+        return item
+
+    # -- drain surface -----------------------------------------------------
+    def get_batch_stream(
+        self, max_items: int, timeout: Optional[float] = None
+    ) -> List[Any]:
+        """Up to ``max_items`` pushed frames: ack everything previously
+        returned (credit replenish), block up to ``timeout`` for the
+        first frame, then take whatever is already buffered without
+        blocking. Returns [] on timeout — and after a mid-stream
+        reconnect (the fresh subscription's redeliveries arrive on the
+        next call)."""
+        c = self._c
+        with c._lock:
+            try:
+                self.ack_consumed()
+                first = self._read_push(timeout)
+            except TransportClosed:
+                raise
+            except (ConnectionError, socket.timeout, OSError) as e:
+                c._reconnect(e)  # re-subscribes; unacked frames redeliver
+                return []
+            if first is EMPTY:
+                return []
+            out = [first]
+            while len(out) < int(max_items):
+                try:
+                    nxt = self._read_push(0.0)
+                except TransportClosed:
+                    break  # deliver what we hold; the next call raises
+                except (ConnectionError, socket.timeout, OSError):
+                    break  # the next call reconnects
+                if nxt is EMPTY:
+                    break
+                out.append(nxt)
+            return out
+
+    def get_wait_stream(self, timeout: Optional[float] = None) -> Any:
+        batch = self.get_batch_stream(1, timeout)
+        return batch[0] if batch else EMPTY

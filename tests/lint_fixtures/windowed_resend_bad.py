@@ -1,0 +1,30 @@
+"""BAD: a windowed-put client that tracks its unacked tail and
+reconnects — but the reconnect path never resends the tail (holes after
+a drop mid-window) and nothing ever prunes it (unbounded growth +
+whole-session duplication on every reconnect)."""
+
+import socket
+import struct
+
+
+class LeakyWindowedClient:
+    def __init__(self, host, port):
+        self._sock = socket.create_connection((host, port))
+        self._seq = 0
+        self._unacked = []  # (seq, payload) — appended, never resent/pruned
+
+    def put_pipelined(self, payload):
+        self._seq += 1
+        self._unacked.append((self._seq, payload))
+        header = struct.pack("<QI", self._seq, len(payload))
+        try:
+            self._sock.sendall(header + payload)
+        except OSError:
+            self._reconnect()
+        return True
+
+    def _reconnect(self):
+        # fresh socket, but the unacked tail is forgotten: every frame
+        # that was in flight when the link dropped is silently lost
+        self._sock.close()
+        self._sock = socket.create_connection((self._sock.getpeername()))

@@ -1,0 +1,39 @@
+"""GOOD: the sanctioned windowed-put idiom — the reconnect path resends
+the entire unacknowledged tail in order before anything else uses the
+fresh connection, and acks prune the tail as they arrive."""
+
+import socket
+import struct
+from collections import deque
+
+
+class WindowedClient:
+    def __init__(self, host, port):
+        self._addr = (host, port)
+        self._sock = socket.create_connection(self._addr)
+        self._seq = 0
+        self._unacked = deque()  # (seq, payload)
+
+    def put_pipelined(self, payload):
+        self._seq += 1
+        self._unacked.append((self._seq, payload))
+        header = struct.pack("<QI", self._seq, len(payload))
+        try:
+            self._sock.sendall(header + payload)
+        except OSError:
+            self._reconnect()
+        return True
+
+    def _drain_acks(self, max_unacked):
+        while len(self._unacked) > max_unacked:
+            (acked,) = struct.unpack("<Q", self._sock.recv(8))
+            while self._unacked and self._unacked[0][0] <= acked:
+                self._unacked.popleft()  # window advance
+
+    def _reconnect(self):
+        self._sock.close()
+        self._sock = socket.create_connection(self._addr)
+        # resend invariant: the whole tail, in sequence order, FIRST
+        for seq, payload in list(self._unacked):
+            header = struct.pack("<QI", seq, len(payload))
+            self._sock.sendall(header + payload)

@@ -224,6 +224,98 @@ def test_wire_protocol_checker_verifies_anchor_opcode_both_ways():
     assert not result.findings, result.findings
 
 
+def test_wire_protocol_checker_flags_sent_but_never_dispatched():
+    """ISSUE 5 satellite: a new opcode wired into the sender but never
+    dispatched must be a lint finding (the runtime symptom is the peer
+    answering protocol-error and dropping the connection on first use)."""
+    bad = FIXTURES / "wire_protocol_bad.py"
+    result = run_lint(paths=[bad], checkers=["wire-protocol"], use_allowlist=False)
+    flush = [f for f in result.findings if "_OP_FLUSH" in f.message]
+    assert len(flush) == 1, result.findings
+    assert "never matched" in flush[0].message  # sent, no dispatch arm
+
+
+def test_wire_protocol_checker_verifies_streaming_opcodes_both_ways():
+    """The streaming/windowed opcodes (ISSUE 5: 'M' subscribe, 'K'
+    cumulative ack, 'W' windowed put, 'U' bounded-wait put, 'D'
+    bounded-wait get-batch) must stay wired on both sides — deleting a
+    sender or a dispatch arm becomes a tier-1 failure, not a runtime
+    protocol error."""
+    import ast
+
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    tree = ast.parse(tcp.read_text())
+    defined = {
+        n.targets[0].id
+        for n in tree.body
+        if isinstance(n, ast.Assign) and isinstance(n.targets[0], ast.Name)
+    }
+    for op in (
+        "_OP_STREAM",
+        "_OP_STREAM_ACK",
+        "_OP_PUT_SEQ",
+        "_OP_PUT_WAIT",
+        "_OP_GET_BATCH_WAIT",
+    ):
+        assert op in defined, f"{op} opcode constant missing from tcp.py"
+    # the generic checker sees every one both ways (whole-file scan clean)
+    result = run_lint(paths=[tcp], checkers=["wire-protocol"])
+    assert not result.findings, result.findings
+
+
+def test_blocking_checker_covers_the_stream_reader_path():
+    """ISSUE 5 satellite: the server-push stream drain the batcher
+    prefers (getattr get_batch_stream indirection) must be inside the
+    blocking-hot-path call graph — a sleep smuggled into a stream reader
+    has to flag even though the getattr hides the edge."""
+    import textwrap
+
+    path = FIXTURES / "_tmp_stream_reader_sleep.py"
+    path.write_text(textwrap.dedent("""
+        import time
+
+
+        def batches_from_queue(queue, batch_size):
+            pop = getattr(queue, "get_batch_stream", None) or queue.get_batch
+            while True:
+                items = pop(batch_size, timeout=0.01)
+                if not items:
+                    return
+                yield items
+
+
+        class StreamReader:
+            def get_batch_stream(self, max_items, timeout=None):
+                time.sleep(0.001)  # must flag: stall in the drain loop
+                return []
+    """))
+    try:
+        result = run_lint(paths=[path], checkers=["blocking-hot-path"])
+        hits = [
+            f
+            for f in result.findings
+            if "time.sleep" in f.message and "get_batch_stream" in f.message
+        ]
+        assert hits, result.findings
+    finally:
+        path.unlink()
+
+
+def test_real_stream_reader_is_reachable_and_clean():
+    """...and the REAL TcpStreamReader is in that audited set (the
+    TcpQueueClient exclusion must not swallow it) with no findings: its
+    waits are caller-timeout-bounded socket reads, never sleeps."""
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    batcher = REPO_ROOT / "psana_ray_tpu" / "infeed" / "batcher.py"
+    result = run_lint(paths=[tcp, batcher], checkers=["blocking-hot-path"])
+    assert not result.findings, result.findings
+    # reachability, not just absence-of-findings: the checker's seed
+    # edges must name the stream drain
+    from psana_ray_tpu.lint.checkers.blocking import SEED_EDGES
+
+    assert "get_batch_stream" in SEED_EDGES["batches_from_queue"]
+
+
 def test_duration_covers_parsing_not_just_checking():
     # the <5s budget must measure what an operator waits for: a full run
     # spends most of its time reading+parsing, which duration_s includes

@@ -841,6 +841,15 @@ def main():
         lambda: _bench_host_datapath(extras, smoke),
     )
 
+    # ---------------- connection scaling: C10K event-loop server ---------
+    # device-free: 16/128/1024 streamed subscribers, event-loop vs
+    # thread-per-connection A/B (ISSUE 6)
+    run_section(
+        wd,
+        "connection-scaling",
+        lambda: _bench_connection_scaling(extras, smoke),
+    )
+
     # ---------------- config 5: multi-detector fan-in --------------------
     # two independent sections: the kHz HOST demonstration must not lose
     # its number to a tunnel-bound device leg timing out (round-3 run:
@@ -2302,6 +2311,173 @@ def _bench_host_datapath(extras, smoke=False):
         f"flight, {occupancy['acks']} acks, "
         f"{occupancy['redelivered']} redelivered)"
     )
+
+
+def _bench_connection_scaling(extras, smoke=False):
+    """C10K row (ISSUE 6): fps and RSS delta at 16 / 128 / 1024 streamed
+    subscribers on loopback, event-loop vs thread-per-connection A/B.
+
+    Each subscriber is a raw streamed socket (subscribe 'M', cumulative
+    'K' acks, final 'F') multiplexed on ONE client-side selector — a
+    full TcpQueueClient per subscriber would measure client-object
+    overhead, not the server. One producer pushes 16 KB u16 frames
+    through one shared queue; fps is total fleet delivery rate. The
+    thread-per-connection A/B stops at 128 subscribers (a thousand
+    Python threads on this box IS the failure mode the event loop
+    removes; measuring it would burn the section budget proving it).
+
+    Acceptance (ISSUE 6): at 1024 subscribers the event loop sustains
+    >=80% of its own 16-subscriber fps, thread count stays flat, and
+    per-connection RSS growth stays <=64 KB. Recorded per row:
+    ``{mode, conns, fps, rss_kb_per_conn, thread_delta}``.
+    """
+    import selectors as _selectors
+    import socket as _socket
+    import struct as _struct
+    import threading as _threading
+
+    from psana_ray_tpu.records import FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+    def rss_kb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    shape = (2, 64, 64)  # 16 KB u16 frames: wire work without bandwidth domination
+    rng = np.random.default_rng(11)
+    frames = [
+        FrameRecord(0, i, rng.integers(0, 4096, size=shape, dtype=np.uint16), 1.0)
+        for i in range(4)
+    ]
+    n_frames = 200 if smoke else 2000
+    counts = (4, 16) if smoke else (16, 128, 1024)
+    threaded_cap = 16 if smoke else 128
+
+    def run_fleet(mode, n_subs):
+        q = RingBuffer(256)
+        srv = TcpQueueServer(q, host="127.0.0.1", mode=mode).serve_background()
+        sel = _selectors.DefaultSelector()
+        socks = []
+        prod = None
+        try:
+            threads0 = _threading.active_count()
+            rss0 = rss_kb()
+            for _ in range(n_subs):
+                s = _socket.create_connection(("127.0.0.1", srv.port), timeout=30.0)
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                s.sendall(b"M" + _struct.pack("<I", 8))
+                s.setblocking(False)
+                st = {"sock": s, "buf": bytearray(), "delivered": 0}
+                sel.register(s, _selectors.EVENT_READ, st)
+                socks.append(st)
+            rss_per_conn = (rss_kb() - rss0) / n_subs
+            thread_delta = _threading.active_count() - threads0
+            prod = TcpQueueClient("127.0.0.1", srv.port)
+
+            def produce():
+                for i in range(n_frames):
+                    if not prod.put_wait(frames[i % 4], timeout=120.0):
+                        return
+
+            got = 0
+            t = _threading.Thread(target=produce, daemon=True)
+            t0 = time.perf_counter()
+            t.start()
+            deadline = t0 + 600.0
+            while got < n_frames and time.perf_counter() < deadline:
+                for key, _m in sel.select(timeout=0.25):
+                    st = key.data
+                    try:
+                        data = st["sock"].recv(1 << 16)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    if not data:
+                        sel.unregister(st["sock"])
+                        continue
+                    buf = st["buf"]
+                    buf += data
+                    fresh = 0
+                    while len(buf) >= 13 and buf[0:1] == b"1":
+                        seq, ln = _struct.unpack_from("<QI", buf, 1)
+                        if len(buf) < 13 + ln:
+                            break
+                        st["delivered"] = seq
+                        del buf[: 13 + ln]
+                        fresh += 1
+                    if fresh:
+                        got += fresh
+                        st["sock"].sendall(
+                            b"K" + _struct.pack("<Q", st["delivered"])
+                        )
+            dt = time.perf_counter() - t0
+            t.join(timeout=10.0)
+            if got < n_frames:
+                raise RuntimeError(
+                    f"fleet starved: {got}/{n_frames} frames at "
+                    f"{mode}/{n_subs} subscribers"
+                )
+            return {
+                "mode": mode,
+                "conns": n_subs,
+                "fps": round(n_frames / dt, 1),
+                "rss_kb_per_conn": round(rss_per_conn, 2),
+                "thread_delta": thread_delta,
+            }
+        finally:
+            for st in socks:
+                try:
+                    st["sock"].setblocking(True)
+                    st["sock"].sendall(
+                        b"K" + _struct.pack("<Q", st["delivered"]) + b"F"
+                    )
+                except OSError:
+                    pass
+                try:
+                    st["sock"].close()
+                except OSError:
+                    pass
+            sel.close()
+            if prod is not None:
+                try:
+                    prod.disconnect()
+                except Exception:
+                    pass
+            srv.shutdown()
+
+    rows = []
+    for mode in ("evloop", "threads"):
+        for n in counts:
+            if mode == "threads" and n > threaded_cap:
+                log(
+                    f"connection-scaling [{mode}]: skipping {n} subscribers "
+                    f"(thread-per-connection at that scale is the failure "
+                    f"mode this section demonstrates the replacement for)"
+                )
+                continue
+            row = run_fleet(mode, n)
+            rows.append(row)
+            log(
+                f"connection-scaling [{row['mode']}, {row['conns']} subs]: "
+                f"{row['fps']:.0f} fps, {row['rss_kb_per_conn']:.1f} "
+                f"KB RSS/conn, +{row['thread_delta']} threads"
+            )
+    extras["connection_scaling"] = rows
+    ev = {r["conns"]: r["fps"] for r in rows if r["mode"] == "evloop"}
+    lo, hi = min(ev), max(ev)
+    if hi > lo:
+        ratio = ev[hi] / ev[lo]
+        extras["connection_scaling_ratio"] = {
+            "conns_hi": hi, "conns_lo": lo, "fps_ratio": round(ratio, 3),
+        }
+        log(
+            f"connection-scaling: {hi}-subscriber fps is "
+            f"{100 * ratio:.0f}% of the {lo}-subscriber fps "
+            f"(acceptance: >=80%, no collapse)"
+        )
 
 
 def _bench_fanin_host(extras, smoke=False):

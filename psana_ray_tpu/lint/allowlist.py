@@ -103,4 +103,21 @@ ALLOWLIST = (
         why="put_wait/get_wait poll backoff: deadline-checked every "
         "iteration; poll_s and timeout are caller-supplied bounds",
     ),
+    # -- event-loop-blocking: shm backing branches that are dead under the
+    # arguments the loop actually passes ---------------------------------
+    Allow(
+        "event-loop-blocking", "transport/shm_ring.py", "time.sleep(0.0002)",
+        why="_get_batch first-item poll: the event loop only ever calls "
+        "get_batch(timeout=0.0) (pump + timer-expiry paths), so the "
+        "deadline is pre-expired and the sleep branch is unreachable "
+        "from the loop; bounded-wait 'D' service is timer state, not a "
+        "blocking pop",
+    ),
+    Allow(
+        "event-loop-blocking", "transport/shm_ring.py", "time.sleep(poll_s)",
+        why="ShmRingBuffer.put_wait reached only through the recovery "
+        "requeue (return_to_queue) for backings WITHOUT put_front — and "
+        "EventLoop.requeue_items hands exactly that case to a bounded "
+        "daemon helper thread, so the loop thread never runs this branch",
+    ),
 )

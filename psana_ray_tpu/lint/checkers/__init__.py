@@ -10,6 +10,7 @@ registered checker has one).
 
 from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
     blocking,
+    evblocking,
     hotalloc,
     leases,
     locks,

@@ -46,6 +46,29 @@ def main(argv=None):
         ),
     )
     p.add_argument(
+        "--server_mode",
+        choices=("evloop", "threads"),
+        default=None,
+        help=(
+            "connection-serving architecture: 'evloop' (default) runs ONE "
+            "epoll readiness loop with per-connection state machines — "
+            "thread count independent of connection count, scales to "
+            "thousands of streamed subscribers; 'threads' is the legacy "
+            "thread-per-connection path, retained for one release "
+            "(PSANA_TCP_SERVER_MODE overrides the default)"
+        ),
+    )
+    p.add_argument(
+        "--max_conns",
+        type=int,
+        default=0,
+        help=(
+            "admission control: refuse connections past this many with a "
+            "clean protocol error instead of accepting unboundedly (an "
+            "accept storm must not OOM the relay); 0 = unlimited"
+        ),
+    )
+    p.add_argument(
         "--drain_s",
         type=float,
         default=10.0,
@@ -112,11 +135,15 @@ def main(argv=None):
 
     server = TcpQueueServer(
         backing, host=a.host, port=a.port, maxsize=a.queue_size,
-        queue_factory=queue_factory,
+        queue_factory=queue_factory, mode=a.server_mode,
+        max_conns=a.max_conns,
     ).serve_background()
     logger.info(
-        "queue server listening on %s:%d (size=%d) — clients use --address tcp://<host>:%d",
-        a.host, server.port, a.queue_size, server.port,
+        "queue server listening on %s:%d (size=%d, mode=%s%s) — clients "
+        "use --address tcp://<host>:%d",
+        a.host, server.port, a.queue_size, server.mode,
+        f", max_conns={a.max_conns}" if a.max_conns else "",
+        server.port,
     )
 
     # Observability: every queue (default + OPENed named ones) as a

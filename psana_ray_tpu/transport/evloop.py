@@ -1,0 +1,1270 @@
+# lint: hot-path
+"""Event-loop TCP queue server: one epoll loop, thousands of streamed
+consumers (ISSUE 6).
+
+The thread-per-connection server in :mod:`transport.tcp` is fine at tens
+of consumers and dead at thousands: a thread stack (plus an ack-reader
+thread per streamed subscriber), GIL contention across serve threads,
+and lock convoys on the shared queue maps. PR 5's server-push streaming
+already removed the request/response coupling, so the relay is shaped
+like an event loop — this module is that loop.
+
+Design:
+
+- ONE thread runs a ``selectors.DefaultSelector`` (epoll on Linux)
+  readiness loop: non-blocking accept, non-blocking incremental reads,
+  non-blocking scatter-gather writes with EPOLLOUT-driven partial-send
+  resumption. Thread count is independent of connection count; memory
+  is O(connections x small struct).
+- Each connection is a :class:`_EvConn` state machine over the SAME 16
+  opcodes and wire bytes as the threaded server (the opcode constants
+  and part-gathering helpers are imported from ``transport.tcp``, so
+  the wire format cannot fork). Reads land incrementally: control
+  fields into a per-connection reused scratch buffer, payloads straight
+  into pooled ``recv_into`` leases (the zero-copy datapath of ISSUE 2
+  is unchanged — a PUT's pooled buffer is the very memory a later
+  push/GET response streams from).
+- Blocking waits become deferred state, not parked threads: a 'D'
+  (bounded get-batch) against an empty queue, a 'U' (bounded put)
+  against a full queue, a 'W' (windowed put) enqueue under
+  backpressure, and a stream with an exhausted credit window all park
+  the connection as a *waiter* on its queue. Waiters are served by the
+  pump when queue state changes (an in-loop enqueue/dequeue, a
+  RingBuffer change listener poking the loop's waker pipe, or — for
+  backings without listeners, e.g. shm rings fed by other processes —
+  a short poll tick), and bounded waits expire off a timer heap.
+- Delivery contract parity: popped items ride ``conn.in_flight`` until
+  the next opcode (implicit ACK) or BYE, and re-enqueue at queue head
+  when the connection dies first; stream pushes ride the per-connection
+  unacked window and redeliver the exact unacked tail on death.
+  At-least-once, duplicates possible, silent loss never — the same
+  words as the threaded server because it is the same contract.
+
+While a connection has a deferred op outstanding, its reads pause (one
+outstanding request per connection — anything already pipelined waits
+in the kernel buffer) with a 1-byte ``MSG_PEEK`` probe keeping EOF
+detection alive, mirroring the threaded server's ``_peer_hung_up``
+probe during blocking enqueues.
+
+Everything here must stay non-blocking: the ``event-loop-blocking``
+lint checker roots its call graph at :meth:`EventLoop.run` and bans
+``time.sleep``, the blocking send/recv helpers, bare ``acquire()``,
+unbounded joins and unbounded ``Condition.wait`` from everything
+reachable.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import selectors
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.tracing import TRACER
+from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.ring import EMPTY
+from psana_ray_tpu.transport.codec import (
+    decode_payload as _decode,
+    encode_payload_parts as _encode_parts,
+    payload_nbytes as _parts_nbytes,
+)
+from psana_ray_tpu.transport.tcp import (
+    _MAX_PAYLOAD,
+    _OP_ANCHOR,
+    _OP_BYE,
+    _OP_CLOSE,
+    _OP_GET,
+    _OP_GET_BATCH,
+    _OP_GET_BATCH_WAIT,
+    _OP_OPEN,
+    _OP_PUT,
+    _OP_PUT_BATCH,
+    _OP_PUT_SEQ,
+    _OP_PUT_WAIT,
+    _OP_SIZE,
+    _OP_STATS,
+    _OP_STREAM,
+    _OP_STREAM_ACK,
+    _SENDMSG_IOV,
+    _SERVER_WAIT_CAP_S,
+    _ST_CLOSED,
+    _ST_ERR,
+    _ST_NO,
+    _ST_OK,
+    _emit_relay_spans,
+    _gather_parts,
+    _queue_stats_payload,
+    _refuse_conn,
+    _stamp_relay_arrival,
+    STREAM,
+)
+
+# Pump cadence for queues WITHOUT a change listener (shm rings fed by
+# other processes): waiters are re-checked this often. Queues with a
+# listener (RingBuffer) poke the waker pipe on every change, so their
+# tick is only a safety net.
+POLL_TICK_S = 0.02
+LISTENED_TICK_S = 0.25
+IDLE_TICK_S = 0.5
+# liveness re-probe cadence for parked connections whose reads are
+# paused behind pipelined bytes — the same 0.5 s dead-peer detection
+# slice the threaded server's _peer_hung_up loop used
+PROBE_INTERVAL_S = 0.5
+# max frames popped per stream-waiter visit — fairness bound so one
+# wide-window subscriber cannot monopolize a pump pass
+_STREAM_POP_MAX = 64
+
+
+class EvLoopTelemetry:
+    """Loop-health gauges for the event-loop server (obs source
+    ``evloop``): connection counts, admission refusals, and loop lag —
+    how long one dispatch pass holds the loop and how late bounded-wait
+    timers fire. One process-wide instance (:data:`EVLOOP`), registered
+    in the default MetricsRegistry on first loop start."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registered = False  # guarded-by: _lock
+        self.connections = 0  # guarded-by: _lock
+        self.connections_peak = 0  # guarded-by: _lock
+        self.accepted_total = 0  # guarded-by: _lock
+        self.refused_total = 0  # guarded-by: _lock
+        self.loops_total = 0  # guarded-by: _lock
+        self.dispatch_ms_last = 0.0  # guarded-by: _lock
+        self.dispatch_ms_max = 0.0  # guarded-by: _lock
+        self.dispatch_ms_ewma = 0.0  # guarded-by: _lock
+        self.timer_lag_ms_max = 0.0  # guarded-by: _lock
+
+    def ensure_registered(self):
+        with self._lock:
+            if self._registered:
+                return
+            self._registered = True
+        try:
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().register("evloop", self)
+        except Exception:  # obs optional: transport must work without it
+            pass
+
+    def conn_opened(self):
+        with self._lock:
+            self.accepted_total += 1
+            self.connections += 1
+            if self.connections > self.connections_peak:
+                self.connections_peak = self.connections
+
+    def conn_closed(self):
+        with self._lock:
+            self.connections -= 1
+
+    def refused(self):
+        with self._lock:
+            self.refused_total += 1
+
+    def loop_pass(self, dispatch_ms: float):
+        with self._lock:
+            self.loops_total += 1
+            self.dispatch_ms_last = dispatch_ms
+            if dispatch_ms > self.dispatch_ms_max:
+                self.dispatch_ms_max = dispatch_ms
+            self.dispatch_ms_ewma += 0.05 * (dispatch_ms - self.dispatch_ms_ewma)
+
+    def timer_lag(self, lag_ms: float):
+        with self._lock:
+            if lag_ms > self.timer_lag_ms_max:
+                self.timer_lag_ms_max = lag_ms
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "connections": self.connections,
+                "connections_peak": self.connections_peak,
+                "accepted_total": self.accepted_total,
+                "refused_total": self.refused_total,
+                "loops_total": self.loops_total,
+                "dispatch_ms_last": round(self.dispatch_ms_last, 3),
+                "dispatch_ms_max": round(self.dispatch_ms_max, 3),
+                "dispatch_ms_ewma": round(self.dispatch_ms_ewma, 3),
+                "timer_lag_ms_max": round(self.timer_lag_ms_max, 3),
+            }
+
+    # obs registry source protocol
+    def snapshot(self) -> dict:
+        return self.stats()
+
+
+EVLOOP = EvLoopTelemetry()
+
+
+class _StreamState:
+    """Per-connection stream-mode state ('M'): the credit window and the
+    unacked redelivery tail that the threaded server kept in a dedicated
+    serve thread + ack-reader thread, folded into the connection."""
+
+    __slots__ = ("window", "seq", "acked", "unacked", "queue_closed")
+
+    def __init__(self, window: int):
+        self.window = window
+        self.seq = 0
+        self.acked = 0
+        self.unacked: deque = deque()  # (seq, item) in push order
+        self.queue_closed = False
+
+    def budget(self) -> int:
+        return self.window - (self.seq - self.acked)
+
+
+class _QueueState:
+    """Loop-side view of one backing queue: who is waiting on it."""
+
+    __slots__ = ("queue", "get_waiters", "put_waiters", "listened", "unlisten")
+
+    def __init__(self, queue):
+        self.queue = queue
+        self.get_waiters: deque = deque()  # 'D' waiters + stream conns
+        self.put_waiters: deque = deque()  # 'U'/'W' waiters, FIFO
+        self.listened = False
+        self.unlisten = None  # callable removing the change listener
+
+
+class _QueueClosedSignal(Exception):
+    """Internal: the backing queue raised TransportClosed mid-pump."""
+
+
+class _EvConn:
+    """One connection's state machine: incremental reads, an outbound
+    scatter-gather write queue, the in-flight delivery window, and
+    (when subscribed) the stream credit window."""
+
+    __slots__ = (
+        "loop", "sock", "srv", "queue", "in_flight", "out", "out_bytes",
+        "closing", "closed", "stream", "pending", "op_gen",
+        "_hdr", "_hdr_mv", "_target", "_need", "_got", "_cb", "_lease",
+        "_want_read", "_want_write", "_mask", "_sendmsg",
+        "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq",
+        "_open_ns", "_open_nm", "_open_buf",
+    )
+
+    def __init__(self, loop: "EventLoop", sock: socket.socket, srv):
+        self.loop = loop
+        self.sock = sock
+        self.srv = srv
+        self.queue = srv.queue  # rebound by OPEN; default-queue back-compat
+        # popped-but-unconfirmed deliveries: cleared at the next opcode
+        # (implicit ACK), re-enqueued if the connection dies first — the
+        # same delivery contract as the threaded server
+        self.in_flight: List[Any] = []
+        self.out: deque = deque()  # memoryview parts awaiting send
+        self.out_bytes = 0
+        self.closing = False  # flush remaining out bytes, then close
+        self.closed = False
+        self.stream: Optional[_StreamState] = None
+        self.pending: Optional[dict] = None  # deferred 'D'/'U'/'W' state
+        self.op_gen = 0  # staleness guard for timer-heap entries
+        self._hdr = bytearray(64)  # reused control-field scratch
+        self._hdr_mv = memoryview(self._hdr)
+        self._target: Optional[memoryview] = None
+        self._need = 0
+        self._got = 0
+        self._cb = None
+        self._lease = None  # pooled lease a payload is landing in
+        self._want_read = False
+        self._want_write = False
+        self._mask = 0
+        self._sendmsg = getattr(sock, "sendmsg", None)
+        self._qb_remaining = 0
+        self._qb_items: List[Any] = []
+        self._pw_wait_s = 0.0
+        self._w_seq = 0
+        self._open_ns = ""
+        self._open_nm = ""
+        self._open_buf = b""
+
+    # -- read engine ------------------------------------------------------
+    def _arm(self, mv: memoryview, cb, lease=None) -> None:
+        self._lease = lease
+        self._target = mv
+        self._need = mv.nbytes
+        self._got = 0
+        self._cb = cb
+
+    def _expect(self, n: int, cb) -> None:
+        self._arm(self._hdr_mv[:n], cb)
+
+    def _expect_payload(self, n: int, cb) -> None:
+        if n > _MAX_PAYLOAD:
+            raise ConnectionError(
+                f"payload length {n} exceeds wire maximum {_MAX_PAYLOAD}"
+            )
+        lease = self.srv._pool.lease(n)
+        self._arm(lease.mv, cb, lease=lease)
+
+    def _await_op(self) -> None:
+        self._expect(1, self._on_op)
+
+    def on_readable(self) -> None:
+        if self.closed or self.closing:
+            return
+        if self.pending is not None:
+            self._probe_while_pending()
+            return
+        while True:
+            if self._got < self._need:
+                try:
+                    k = self.sock.recv_into(self._target[self._got:])
+                except (BlockingIOError, InterruptedError):
+                    return
+                if k == 0:
+                    raise ConnectionError("peer closed")
+                self._got += k
+                if self._got < self._need:
+                    continue
+            cb = self._cb
+            self._cb = None
+            cb()
+            if self.closed or self.closing or self.pending is not None:
+                return
+            if self._cb is None:  # handler did not arm a next read
+                return
+
+    def _probe_while_pending(self) -> None:
+        """Readable while a deferred op is outstanding: either EOF (the
+        peer died mid-wait — cancel the op, drop the never-enqueued
+        frame, exactly like the threaded server's liveness probe) or
+        pipelined bytes that must wait their turn — pause read interest
+        (level-triggered epoll would spin otherwise) and schedule a
+        liveness re-probe so a peer that dies AFTER pipelining is still
+        detected within the probe interval, matching the threaded
+        server's 0.5 s `_peer_hung_up` slices; without it a crashed
+        windowed producer would pin the parked frame's lease forever
+        and late-enqueue on top of its own reconnect resend."""
+        try:
+            k = self.sock.recv_into(self._hdr_mv[:1], 1, socket.MSG_PEEK)
+        except (BlockingIOError, InterruptedError):
+            return
+        if k == 0:
+            raise ConnectionError("peer closed while op deferred")
+        self._set_interest(read=False)
+        self.loop.add_liveness_probe(self)
+
+    # -- write engine -----------------------------------------------------
+    def send_parts(self, parts) -> None:
+        for m in _gather_parts(parts):
+            self.out.append(m)
+            self.out_bytes += m.nbytes
+        self.flush_out()
+
+    def _send_control(self, b: bytes) -> None:
+        self.send_parts([b])
+
+    def flush_out(self) -> None:
+        if self.closed:
+            return
+        try:
+            while self.out:
+                if self._sendmsg is not None:
+                    bufs = []
+                    for m in self.out:
+                        bufs.append(m)
+                        if len(bufs) >= _SENDMSG_IOV:
+                            break
+                    sent = self._sendmsg(bufs)
+                else:  # platform fallback: one part per send
+                    sent = self.sock.send(self.out[0])
+                if sent <= 0:
+                    raise ConnectionError("peer closed during send")
+                self.out_bytes -= sent
+                while sent:
+                    m = self.out[0]
+                    if sent >= m.nbytes:
+                        sent -= m.nbytes
+                        self.out.popleft()
+                    else:
+                        self.out[0] = m[sent:]
+                        sent = 0
+        except (BlockingIOError, InterruptedError):
+            pass
+        if not self.out and self.closing:
+            self.loop.kill_conn(self, None, requeue=False)
+            return
+        self._set_interest(write=bool(self.out))
+
+    # -- selector interest ------------------------------------------------
+    def _set_interest(self, read: Optional[bool] = None, write: Optional[bool] = None) -> None:
+        if read is not None:
+            self._want_read = read
+        if write is not None:
+            self._want_write = write
+        mask = (selectors.EVENT_READ if self._want_read else 0) | (
+            selectors.EVENT_WRITE if self._want_write else 0
+        )
+        if mask == self._mask or self.closed:
+            return
+        sel = self.loop._sel
+        if self._mask == 0:
+            sel.register(self.sock, mask, self)
+        elif mask == 0:
+            sel.unregister(self.sock)
+        else:
+            sel.modify(self.sock, mask, self)
+        self._mask = mask
+
+    # -- deferred ops -----------------------------------------------------
+    def park(self, kind: str, **state) -> None:
+        self.pending = dict(state, kind=kind)
+        self.op_gen += 1
+
+    def unpark(self) -> None:
+        self.pending = None
+        self.op_gen += 1
+        self._await_op()
+        self._set_interest(read=True)
+
+    # -- opcode dispatch --------------------------------------------------
+    def _on_op(self) -> None:
+        op = self._hdr[0]
+        # previous response fully read by the peer (it can only send the
+        # next request after reading the last response) — implicit ACK
+        self.in_flight = []
+        if self.stream is not None:
+            # a streamed connection carries only acks and BYE upstream
+            if op == _OP_STREAM_ACK[0]:
+                self._expect(8, self._on_stream_ack)
+                return
+            if op == _OP_BYE[0]:
+                self._finish_stream(clean=True)
+                self._begin_close()
+                return
+            raise ConnectionError(
+                f"bad opcode {op:#04x} on streamed connection"
+            )
+        name = _OPS.get(op)
+        if name is None:
+            self._send_control(_ST_ERR)
+            self._begin_close()
+            return
+        getattr(self, name)()
+
+    def _begin_close(self) -> None:
+        """Clean close: flush any queued response bytes, then close
+        without redelivery (the peer said goodbye / protocol-erred)."""
+        if self.out:
+            self.closing = True
+            self._set_interest(read=False, write=True)
+        else:
+            self.loop.kill_conn(self, None, requeue=False)
+
+    # -- responses --------------------------------------------------------
+    def _respond_item(self, item) -> None:
+        parts = _encode_parts(item)
+        head = _ST_OK + struct.pack("<I", _parts_nbytes(parts))
+        self.send_parts([head, *parts])
+
+    def _respond_batch(self, items) -> None:
+        self.in_flight = list(items)
+        parts: List[Any] = [_ST_OK, struct.pack("<I", len(self.in_flight))]
+        for item in self.in_flight:
+            item_parts = _encode_parts(item)
+            parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
+            parts.extend(item_parts)
+        t_send0 = time.monotonic() if TRACER.enabled else 0.0
+        self.send_parts(parts)
+        if TRACER.enabled:
+            _emit_relay_spans(self.in_flight, t_send0)
+
+    def _take_item(self):
+        """Decode the just-received payload zero-copy off its lease."""
+        lease = self._lease
+        self._lease = None
+        try:
+            return _decode(lease.mv, lease=lease)
+        except BaseException:
+            lease.release()
+            raise
+
+    # -- opcode handlers --------------------------------------------------
+    def _op_put(self) -> None:
+        self._expect(4, self._put_hdr)
+
+    def _put_hdr(self) -> None:
+        (n,) = struct.unpack_from("<I", self._hdr)
+        self._expect_payload(n, self._put_payload)
+
+    def _put_payload(self) -> None:
+        item = self._take_item()
+        if TRACER.enabled:
+            _stamp_relay_arrival(item)
+        if self.srv._draining:
+            self._send_control(_ST_CLOSED)
+        else:
+            try:
+                ok = self.queue.put(item)
+            except TransportClosed:
+                self._send_control(_ST_CLOSED)
+            else:
+                self._send_control(_ST_OK if ok else _ST_NO)
+                if ok:
+                    self.loop.queue_touched(self.queue)
+        self._await_op()
+
+    def _op_get(self) -> None:
+        try:
+            item = self.queue.get()
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+        else:
+            if item is EMPTY:
+                self._send_control(_ST_NO)
+            else:
+                self.in_flight = [item]  # held until the next opcode
+                t_send0 = time.monotonic() if TRACER.enabled else 0.0
+                self._respond_item(item)
+                if TRACER.enabled:
+                    _emit_relay_spans(self.in_flight, t_send0)
+                self.loop.queue_touched(self.queue)
+        self._await_op()
+
+    def _op_get_batch(self) -> None:
+        self._expect(4, self._gb_hdr)
+
+    def _gb_hdr(self) -> None:
+        (max_items,) = struct.unpack_from("<I", self._hdr)
+        try:
+            items = self.queue.get_batch(min(max_items, 4096), timeout=0.0)
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+        else:
+            self._respond_batch(items)
+            if items:
+                self.loop.queue_touched(self.queue)
+        self._await_op()
+
+    def _op_get_batch_wait(self) -> None:
+        self._expect(8, self._gbw_hdr)
+
+    def _gbw_hdr(self) -> None:
+        max_items, wait_ms = struct.unpack_from("<II", self._hdr)
+        max_items = min(max_items, 4096)
+        wait_s = min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S)
+        try:
+            items = self.queue.get_batch(max_items, timeout=0.0)
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        if items or wait_s <= 0:
+            self._respond_batch(items)
+            if items:
+                self.loop.queue_touched(self.queue)
+            self._await_op()
+            return
+        # empty queue: the wait becomes timer + waiter state, not a
+        # parked thread — served by the pump or expired by the timer
+        self.park("D", max_items=max_items)
+        self.loop.add_get_waiter(self, time.monotonic() + wait_s)
+
+    def _op_put_wait(self) -> None:
+        self._expect(8, self._pw_hdr)
+
+    def _pw_hdr(self) -> None:
+        wait_ms, n = struct.unpack_from("<II", self._hdr)
+        self._pw_wait_s = min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S)
+        self._expect_payload(n, self._pw_payload)
+
+    def _pw_payload(self) -> None:
+        item = self._take_item()
+        if TRACER.enabled:
+            _stamp_relay_arrival(item)
+        if self.srv._draining:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        try:
+            ok = self.queue.put(item)
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        if ok:
+            self._send_control(_ST_OK)
+            self.loop.queue_touched(self.queue)
+            self._await_op()
+            return
+        if self._pw_wait_s <= 0:
+            self._send_control(_ST_NO)
+            self._await_op()
+            return
+        self.park("U", item=item)
+        self.loop.add_put_waiter(self, time.monotonic() + self._pw_wait_s)
+
+    def _op_put_seq(self) -> None:
+        self._expect(12, self._ws_hdr)
+
+    def _ws_hdr(self) -> None:
+        seq, n = struct.unpack_from("<QI", self._hdr)
+        self._w_seq = seq
+        self._expect_payload(n, self._ws_payload)
+
+    def _ws_payload(self) -> None:
+        item = self._take_item()
+        if TRACER.enabled:
+            _stamp_relay_arrival(item)
+        if self.srv._draining:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        try:
+            ok = self.queue.put(item)
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        if ok:
+            self.send_parts([_ST_OK + struct.pack("<Q", self._w_seq)])
+            self.loop.queue_touched(self.queue)
+            self._await_op()
+            return
+        # backpressure: the ack is delayed until space frees — deferred
+        # state with NO deadline (that delay IS the backpressure signal)
+        self.park("W", item=item, seq=self._w_seq)
+        self.loop.add_put_waiter(self, None)
+
+    def _op_put_batch(self) -> None:
+        self._expect(4, self._qb_count)
+
+    def _qb_count(self) -> None:
+        (count,) = struct.unpack_from("<I", self._hdr)
+        self._qb_remaining = count
+        self._qb_items = []
+        self._qb_next()
+
+    def _qb_next(self) -> None:
+        if self._qb_remaining <= 0:
+            self._qb_finish()
+            return
+        self._qb_remaining -= 1
+        self._expect(4, self._qb_len)
+
+    def _qb_len(self) -> None:
+        (n,) = struct.unpack_from("<I", self._hdr)
+        self._expect_payload(n, self._qb_payload)
+
+    def _qb_payload(self) -> None:
+        self._qb_items.append(self._take_item())
+        self._qb_next()
+
+    def _qb_finish(self) -> None:
+        batch, self._qb_items = self._qb_items, []
+        if TRACER.enabled:
+            for item in batch:
+                _stamp_relay_arrival(item)
+        if self.srv._draining:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        accepted = 0
+        try:
+            for item in batch:
+                if not self.queue.put(item):
+                    break  # full: accepted prefix only (FIFO)
+                accepted += 1
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+            self._await_op()
+            return
+        self.send_parts([_ST_OK + struct.pack("<I", accepted)])
+        if accepted:
+            self.loop.queue_touched(self.queue)
+        self._await_op()
+
+    def _op_stream(self) -> None:
+        self._expect(4, self._stream_hdr)
+
+    def _stream_hdr(self) -> None:
+        (window,) = struct.unpack_from("<I", self._hdr)
+        window = max(1, min(int(window), 4096))
+        self.stream = _StreamState(window)
+        STREAM.opened(window)
+        FLIGHT.record("stream_open", port=self.srv.port, window=window)
+        self.loop.add_stream(self)
+        self._await_op()  # from here: only 'K'/'F' upstream
+
+    def _on_stream_ack(self) -> None:
+        (seq,) = struct.unpack_from("<Q", self._hdr)
+        st = self.stream
+        if seq > st.acked:
+            st.acked = seq
+            STREAM.acked_msg()
+        pruned = 0
+        while st.unacked and st.unacked[0][0] <= st.acked:
+            st.unacked.popleft()  # credit returned: lease may free
+            pruned += 1
+        if pruned:
+            STREAM.pruned(pruned)
+        self.loop.queue_touched(self.queue)  # new credits: pump may push
+        self._await_op()
+
+    def push_stream_items(self, items) -> None:
+        st = self.stream
+        t_send0 = time.monotonic() if TRACER.enabled else 0.0
+        parts: List[Any] = []
+        for item in items:
+            st.seq += 1
+            st.unacked.append((st.seq, item))
+            item_parts = _encode_parts(item)
+            parts.append(
+                _ST_OK + struct.pack("<QI", st.seq, _parts_nbytes(item_parts))
+            )
+            parts.extend(item_parts)
+        self.send_parts(parts)
+        STREAM.pushed(len(items))
+        if TRACER.enabled:
+            _emit_relay_spans(items, t_send0)
+
+    def _finish_stream(self, clean: bool) -> None:
+        """Stream teardown bookkeeping: prune what the final cumulative
+        ack covered, redeliver the rest (requeue at head) unless the
+        queue itself closed — exactly the threaded ``_serve_stream``
+        finally-block."""
+        st, self.stream = self.stream, None
+        if st is None:
+            return
+        pruned = 0
+        while st.unacked and st.unacked[0][0] <= st.acked:
+            st.unacked.popleft()
+            pruned += 1
+        if pruned:
+            STREAM.pruned(pruned)
+        lost = [item for (_s, item) in st.unacked]
+        st.unacked.clear()
+        if lost:
+            STREAM.pruned(len(lost))
+            if not st.queue_closed:
+                STREAM.redelivered_n(len(lost))
+                FLIGHT.record(
+                    "stream_redelivery", count=len(lost), clean_bye=clean
+                )
+                self.loop.requeue_items(self.queue, lost)
+        STREAM.closed(st.window)
+
+    def _op_size(self) -> None:
+        try:
+            n = self.queue.size()
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+        else:
+            self.send_parts([_ST_OK + struct.pack("<I", n)])
+        self._await_op()
+
+    def _op_stats(self) -> None:
+        payload = json.dumps(_queue_stats_payload(self.queue)).encode()
+        self.send_parts([_ST_OK + struct.pack("<I", len(payload)), payload])
+        self._await_op()
+
+    def _op_anchor(self) -> None:
+        self._expect(16, self._anchor_reply)
+
+    def _anchor_reply(self) -> None:
+        # client wall+mono read for RTT symmetry; answer with our pair
+        self.send_parts(
+            [_ST_OK + struct.pack("<dd", time.time(), time.monotonic())]
+        )
+        self._await_op()
+
+    def _op_close(self) -> None:
+        try:
+            self.queue.close()
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+        else:
+            self._send_control(_ST_OK)
+            self.loop.queue_touched(self.queue)
+        self._await_op()
+
+    def _op_bye(self) -> None:
+        # clean goodbye: the previous response is ACKed (in_flight was
+        # already cleared when this opcode arrived)
+        self._begin_close()
+
+    def _op_open(self) -> None:
+        self._expect(2, self._open_ns_len)
+
+    def _open_ns_len(self) -> None:
+        (ns_len,) = struct.unpack_from("<H", self._hdr)
+        # name fields are u16-length control strings; a dedicated exact-
+        # size buffer (OPEN runs once per connection, off the hot path)
+        self._open_buf = bytearray(ns_len)
+        self._arm(memoryview(self._open_buf), self._open_ns_done)
+
+    def _open_ns_done(self) -> None:
+        self._open_ns = self._open_buf.decode()
+        self._expect(2, self._open_nm_len)
+
+    def _open_nm_len(self) -> None:
+        (nm_len,) = struct.unpack_from("<H", self._hdr)
+        self._open_buf = bytearray(nm_len)
+        self._arm(memoryview(self._open_buf), self._open_nm_done)
+
+    def _open_nm_done(self) -> None:
+        self._open_nm = self._open_buf.decode()
+        self._expect(4, self._open_finish)
+
+    def _open_finish(self) -> None:
+        (maxsize,) = struct.unpack_from("<I", self._hdr)
+        self.queue = self.srv.open_named(
+            self._open_ns, self._open_nm, maxsize or None
+        )
+        self._send_control(_ST_OK)
+        self._await_op()
+
+
+_OPS: Dict[int, str] = {
+    _OP_PUT[0]: "_op_put",
+    _OP_GET[0]: "_op_get",
+    _OP_SIZE[0]: "_op_size",
+    _OP_CLOSE[0]: "_op_close",
+    _OP_GET_BATCH[0]: "_op_get_batch",
+    _OP_GET_BATCH_WAIT[0]: "_op_get_batch_wait",
+    _OP_PUT_BATCH[0]: "_op_put_batch",
+    _OP_PUT_WAIT[0]: "_op_put_wait",
+    _OP_PUT_SEQ[0]: "_op_put_seq",
+    _OP_STREAM[0]: "_op_stream",
+    _OP_OPEN[0]: "_op_open",
+    _OP_STATS[0]: "_op_stats",
+    _OP_ANCHOR[0]: "_op_anchor",
+    _OP_BYE[0]: "_op_bye",
+}
+
+
+class EventLoop:
+    """The one loop: accepts, reads, writes, fires bounded-wait timers
+    and pumps queue waiters — for a :class:`~psana_ray_tpu.transport.
+    tcp.TcpQueueServer` constructed with ``mode="evloop"``."""
+
+    def __init__(self, server):
+        self._srv = server
+        self._sel = selectors.DefaultSelector()
+        self._conns: set = set()
+        self._queues: Dict[int, _QueueState] = {}
+        self._timers: List[tuple] = []  # heap: (deadline, tie, conn, gen)
+        self._timer_tie = 0
+        # waker: listener callbacks / shutdown poke this pipe so the
+        # selector wakes immediately instead of at the next tick
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self._waker_w.setblocking(False)
+        self._waker_buf = bytearray(512)
+        self._waker_mv = memoryview(self._waker_buf)
+        self._ACCEPT = object()
+        self._WAKER = object()
+        self._loop_tid: Optional[int] = None
+
+    # -- cross-thread pokes ----------------------------------------------
+    def wake(self) -> None:
+        # The loop's own queue ops fire the RingBuffer listeners too —
+        # a self-poke would cost two syscalls plus a spurious zero-wait
+        # select pass PER FRAME. The loop is by definition awake when it
+        # is the caller, and _pump_all runs at the end of every pass, so
+        # only other threads need the pipe.
+        if threading.get_ident() == self._loop_tid:
+            return
+        try:
+            self._waker_w.send(b"w")
+        except (BlockingIOError, InterruptedError, OSError):
+            pass  # pipe full = a wakeup is already pending; closed = exiting
+
+    # -- queue-state plumbing --------------------------------------------
+    def _qs(self, queue) -> _QueueState:
+        qs = self._queues.get(id(queue))
+        if qs is None:
+            qs = _QueueState(queue)
+            self._queues[id(queue)] = qs
+            add = getattr(queue, "add_listener", None)
+            if add is not None:
+                try:
+                    add(self.wake)
+                    qs.listened = True
+                    remove = getattr(queue, "remove_listener", None)
+                    if remove is not None:
+                        qs.unlisten = lambda: remove(self.wake)
+                except Exception:
+                    qs.listened = False
+        return qs
+
+    def queue_touched(self, queue) -> None:
+        """An in-loop op changed this queue's state; the per-iteration
+        pump pass will serve its waiters (this is just a cheap no-op
+        hook kept for readability and future per-queue dirty tracking)."""
+
+    def add_get_waiter(self, conn: _EvConn, deadline: Optional[float]) -> None:
+        self._qs(conn.queue).get_waiters.append(conn)
+        if deadline is not None:
+            self._add_timer(deadline, conn)
+
+    def add_put_waiter(self, conn: _EvConn, deadline: Optional[float]) -> None:
+        self._qs(conn.queue).put_waiters.append(conn)
+        if deadline is not None:
+            self._add_timer(deadline, conn)
+
+    def add_stream(self, conn: _EvConn) -> None:
+        self._qs(conn.queue).get_waiters.append(conn)
+
+    def add_liveness_probe(self, conn: _EvConn) -> None:
+        """Re-check a parked, read-paused connection for EOF every
+        PROBE_INTERVAL_S: re-arming read interest makes the next select
+        pass run the MSG_PEEK probe again (which re-pauses and
+        reschedules if the pipelined bytes are still waiting)."""
+        self._add_timer(
+            time.monotonic() + PROBE_INTERVAL_S, conn, kind="probe"
+        )
+
+    def _add_timer(self, deadline: float, conn: _EvConn, kind: str = "op") -> None:
+        self._timer_tie += 1
+        heapq.heappush(
+            self._timers, (deadline, self._timer_tie, conn, conn.op_gen, kind)
+        )
+
+    # -- redelivery -------------------------------------------------------
+    def requeue_items(self, queue, items) -> None:
+        """Head-requeue via the shared recovery path. Backings without
+        ``put_front`` (shm rings) take the timed-retry path, which can
+        block — hand those to a short-lived helper thread so the loop
+        never parks (connection death is rare; the thread is bounded by
+        the recovery timeout and daemonic)."""
+        if not items:
+            return
+        if getattr(queue, "put_front", None) is not None:
+            self._srv._requeue(queue, items)  # non-blocking head placement
+        else:
+            threading.Thread(
+                target=self._srv._requeue, args=(queue, items),
+                daemon=True, name="tcp-evloop-requeue",
+            ).start()
+
+    # -- connection lifecycle --------------------------------------------
+    def kill_conn(self, conn: _EvConn, cause, requeue: bool = True) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        if conn._mask:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            conn._mask = 0
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._conns.discard(conn)
+        EVLOOP.conn_closed()
+        if conn._lease is not None:  # payload died mid-read
+            conn._lease.release()
+            conn._lease = None
+        # a parked 'U'/'W' item was never enqueued: drop it — the client
+        # is dead (its windowed-put resend redelivers on reconnect), and
+        # enqueueing now would stack a duplicate on top of that resend
+        conn.pending = None
+        conn._qb_items = []
+        if requeue:
+            if conn.in_flight:
+                self.requeue_items(conn.queue, conn.in_flight)
+                conn.in_flight = []
+            conn._finish_stream(clean=False)
+        else:
+            if conn.stream is not None:
+                conn._finish_stream(clean=True)
+
+    # -- the loop ---------------------------------------------------------
+    def run(self) -> None:
+        srv = self._srv
+        self._loop_tid = threading.get_ident()
+        EVLOOP.ensure_registered()
+        try:
+            srv._sock.setblocking(False)
+        except OSError:
+            return  # shutdown() closed the socket before we got here
+        self._sel.register(srv._sock, selectors.EVENT_READ, self._ACCEPT)
+        self._sel.register(self._waker_r, selectors.EVENT_READ, self._WAKER)
+        try:
+            while not srv._stop.is_set():
+                events = self._sel.select(self._select_timeout())
+                t0 = time.monotonic()
+                for key, mask in events:
+                    data = key.data
+                    if data is self._ACCEPT:
+                        self._accept()
+                    elif data is self._WAKER:
+                        self._drain_waker()
+                    else:
+                        self._dispatch_conn(data, mask)
+                self._fire_timers()
+                self._pump_all()
+                EVLOOP.loop_pass((time.monotonic() - t0) * 1000.0)
+        finally:
+            self._teardown()
+
+    def _dispatch_conn(self, conn: _EvConn, mask: int) -> None:
+        try:
+            if mask & selectors.EVENT_WRITE:
+                conn.flush_out()
+            if mask & selectors.EVENT_READ and not conn.closed:
+                conn.on_readable()
+        except (ConnectionError, OSError) as e:
+            self.kill_conn(conn, e)
+        except Exception as e:  # noqa: BLE001 — one bad conn must not kill the loop
+            self.kill_conn(conn, e)
+
+    def _accept(self) -> None:
+        srv = self._srv
+        while True:
+            try:
+                sock, _ = srv._sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            n_active = len(self._conns)
+            if srv.max_conns and n_active >= srv.max_conns:
+                EVLOOP.refused()
+                try:
+                    sock.setblocking(False)
+                except OSError:
+                    pass
+                _refuse_conn(sock, srv.port, n_active, srv.max_conns)
+                continue
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _EvConn(self, sock, srv)
+            self._conns.add(conn)
+            with srv._conns_lock:  # shutdown() parity sweep sees them too
+                srv._conns = [c for c in srv._conns if c.fileno() != -1]
+                srv._conns.append(sock)
+            EVLOOP.conn_opened()
+            conn._await_op()
+            conn._set_interest(read=True)
+
+    def _drain_waker(self) -> None:
+        while True:
+            try:
+                k = self._waker_r.recv_into(self._waker_mv)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if k == 0:
+                return
+
+    def _select_timeout(self) -> float:
+        now = time.monotonic()
+        t = IDLE_TICK_S
+        if self._timers:
+            t = min(t, max(0.0, self._timers[0][0] - now))
+        waiting = unlistened = False
+        for qs in self._queues.values():
+            if qs.get_waiters or qs.put_waiters:
+                waiting = True
+                if not qs.listened:
+                    unlistened = True
+                    break
+        if unlistened:
+            t = min(t, POLL_TICK_S)
+        elif waiting:
+            t = min(t, LISTENED_TICK_S)
+        return t
+
+    def _fire_timers(self) -> None:
+        now = time.monotonic()
+        while self._timers and self._timers[0][0] <= now:
+            deadline, _tie, conn, gen, tkind = heapq.heappop(self._timers)
+            if conn.closed or conn.pending is None or gen != conn.op_gen:
+                continue  # already served / superseded
+            if tkind == "probe":
+                # parked with reads paused: re-arm read interest so the
+                # next select pass re-runs the EOF probe
+                conn._set_interest(read=True)
+                continue
+            EVLOOP.timer_lag((now - deadline) * 1000.0)
+            kind = conn.pending["kind"]
+            try:
+                if kind == "D":
+                    # one last non-blocking look, then the empty answer
+                    try:
+                        items = conn.queue.get_batch(
+                            conn.pending["max_items"], timeout=0.0
+                        )
+                    except TransportClosed:
+                        conn._send_control(_ST_CLOSED)
+                        conn.unpark()
+                        continue
+                    conn._respond_batch(items)
+                    conn.unpark()
+                elif kind == "U":
+                    conn._send_control(_ST_NO)
+                    conn.unpark()
+                # "W" carries no deadline: backpressure, not timeout
+            except (ConnectionError, OSError) as e:
+                self.kill_conn(conn, e)
+
+    # -- the pump: serve waiters when queue state may have changed --------
+    def _pump_all(self) -> None:
+        for qs in list(self._queues.values()):
+            if not (qs.get_waiters or qs.put_waiters):
+                continue
+            try:
+                progressed = True
+                while progressed:
+                    progressed = self._pump_get(qs) | self._pump_put(qs)
+            except _QueueClosedSignal:
+                self._queue_closed(qs)
+
+    def _pump_get(self, qs: _QueueState) -> bool:
+        did = False
+        gw = qs.get_waiters
+        if gw:
+            # cheap emptiness probe first: the pump runs on every loop
+            # pass, and an idle queue must cost a depth check, not a
+            # get_batch per waiter per tick (round-trip-economy parity
+            # with the threaded server's single blocking get_batch).
+            # size() alone is not a liveness probe — RingBuffer.size()
+            # answers 0 on a CLOSED queue — so check closed explicitly
+            # (waiting streams must see 'X' promptly).
+            try:
+                if getattr(qs.queue, "closed", False):
+                    raise _QueueClosedSignal
+                if not qs.queue.size():
+                    return False
+            except TransportClosed:
+                raise _QueueClosedSignal from None
+        visits = len(gw)
+        while visits and gw:
+            visits -= 1
+            conn = gw[0]
+            if conn.closed:
+                gw.popleft()
+                continue
+            if conn.stream is not None:
+                want = min(conn.stream.budget(), _STREAM_POP_MAX)
+                if want <= 0:
+                    gw.rotate(-1)  # window full: wait for credits
+                    continue
+            elif conn.pending is not None and conn.pending.get("kind") == "D":
+                want = conn.pending["max_items"]
+            else:
+                gw.popleft()  # served by a timer / superseded
+                continue
+            try:
+                items = qs.queue.get_batch(min(want, 4096), timeout=0.0)
+            except TransportClosed:
+                raise _QueueClosedSignal from None
+            if not items:
+                break  # queue empty: every remaining get-waiter waits
+            try:
+                if conn.stream is not None:
+                    conn.push_stream_items(items)
+                    gw.rotate(-1)  # round-robin fairness across streams
+                else:
+                    conn._respond_batch(items)
+                    gw.popleft()
+                    conn.unpark()
+            except (ConnectionError, OSError) as e:
+                # the waiter died with items popped: standard redelivery
+                self.kill_conn(conn, e)
+            did = True
+        return did
+
+    def _pump_put(self, qs: _QueueState) -> bool:
+        did = False
+        pw = qs.put_waiters
+        while pw:
+            conn = pw[0]
+            if conn.closed or conn.pending is None or conn.pending.get(
+                "kind"
+            ) not in ("U", "W"):
+                pw.popleft()
+                continue
+            try:
+                ok = qs.queue.put(conn.pending["item"])
+            except TransportClosed:
+                raise _QueueClosedSignal from None
+            if not ok:
+                break  # still full: FIFO — nobody behind may jump the line
+            pw.popleft()
+            try:
+                if conn.pending["kind"] == "W":
+                    conn.send_parts(
+                        [_ST_OK + struct.pack("<Q", conn.pending["seq"])]
+                    )
+                else:
+                    conn._send_control(_ST_OK)
+                conn.unpark()
+            except (ConnectionError, OSError) as e:
+                self.kill_conn(conn, e)
+            did = True
+        return did
+
+    def _queue_closed(self, qs: _QueueState) -> None:
+        """The backing queue raised TransportClosed mid-pump: answer
+        every waiter with 'X' (bounded waits resume the connection;
+        streams end — the threaded server's stream loop did the same)."""
+        while qs.get_waiters:
+            conn = qs.get_waiters.popleft()
+            if conn.closed:
+                continue
+            try:
+                if conn.stream is not None:
+                    conn.stream.queue_closed = True
+                    conn._send_control(_ST_CLOSED)  # the stream is over
+                    conn._finish_stream(clean=False)
+                    conn._begin_close()
+                else:
+                    conn._send_control(_ST_CLOSED)
+                    conn.unpark()
+            except (ConnectionError, OSError) as e:
+                self.kill_conn(conn, e)
+        while qs.put_waiters:
+            conn = qs.put_waiters.popleft()
+            if conn.closed or conn.pending is None:
+                continue
+            try:
+                conn._send_control(_ST_CLOSED)
+                conn.unpark()
+            except (ConnectionError, OSError) as e:
+                self.kill_conn(conn, e)
+
+    def _teardown(self) -> None:
+        for conn in list(self._conns):
+            # server stopping: redeliver in-flight/unacked to the queues
+            # (parity with the threaded server, whose dying serve
+            # threads requeue on the forced disconnect)
+            self.kill_conn(conn, None, requeue=True)
+        for qs in self._queues.values():
+            if qs.unlisten is not None:
+                try:
+                    qs.unlisten()
+                except Exception:
+                    pass
+        for s in (self._waker_r, self._waker_w):
+            try:
+                self._sel.unregister(s)
+            except (KeyError, ValueError, OSError):
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._sel.unregister(self._srv._sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        self._sel.close()

@@ -61,6 +61,12 @@ class RingBuffer:
         self._not_full = threading.Condition(self._lock)
         self._closed = False  # guarded-by: _lock
         self._draining = False  # guarded-by: _lock
+        # change listeners: non-blocking callbacks poked after any state
+        # change a waiter could care about (item added, space freed,
+        # close/drain) — the event-loop TCP server registers its waker
+        # here so an in-process put wakes the selector immediately
+        # instead of at the next poll tick
+        self._listeners: list = []  # guarded-by: _lock
         # lifetime counters (observability the reference lacks, SURVEY.md §5)
         self._n_put = 0  # guarded-by: _lock
         self._n_get = 0  # guarded-by: _lock
@@ -113,7 +119,33 @@ class RingBuffer:
             if len(self._q) > self._high_water:
                 self._high_water = len(self._q)
             self._not_empty.notify()
+            self._notify_listeners()
             return True
+
+    # -- change listeners -------------------------------------------------
+    def add_listener(self, cb) -> None:
+        """Register a NON-BLOCKING callback invoked (with the queue lock
+        held — keep it to a self-pipe write or a flag set) after any
+        put/get/close/drain state change. Used by the event-loop TCP
+        server's waker so waiters are served the instant an in-process
+        producer enqueues."""
+        with self._lock:
+            self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(cb)
+            except ValueError:
+                pass
+
+    def _notify_listeners(self):
+        # guarded-by-caller: _lock
+        for cb in self._listeners:
+            try:
+                cb()
+            except Exception:  # a broken listener must not break the queue
+                pass
 
     # -- blocking variants (new capability) ------------------------------
     def put_wait(self, item: Any, timeout: Optional[float] = None) -> bool:
@@ -171,6 +203,7 @@ class RingBuffer:
             self._closed = True
             self._not_empty.notify_all()
             self._not_full.notify_all()
+            self._notify_listeners()
 
     def begin_drain(self):
         """Half-close for graceful teardown: producers are refused (they
@@ -179,6 +212,7 @@ class RingBuffer:
         with self._lock:
             self._draining = True
             self._not_full.notify_all()
+            self._notify_listeners()
 
     @property
     def closed(self) -> bool:
@@ -203,11 +237,13 @@ class RingBuffer:
         if depth > self._high_water:
             self._high_water = depth
         self._last_put_t = time.monotonic()
+        self._notify_listeners()
 
     def _note_get(self, n: int = 1):
         # guarded-by-caller: _lock
         self._n_get += n
         self._last_get_t = time.monotonic()
+        self._notify_listeners()
 
     def stats(self) -> dict:
         """Depth + lifetime counters + the health fields the stall

@@ -135,6 +135,17 @@ redelivery could still need the payload.
 In-flight items are never dropped on a consumer crash: if the connection
 dies between the queue pop and the response write, the server re-enqueues
 the popped item(s).
+
+Server architecture (ISSUE 6): the default server is a single
+selectors/epoll readiness loop (:mod:`psana_ray_tpu.transport.evloop`)
+driving a per-connection state machine over all 16 opcodes — memory
+O(connections x small struct), thread count independent of connection
+count, blocking waits ('W'/'U'/'D', stream credit stalls) held as
+timer/deferred-callback state instead of parked threads. The
+thread-per-connection implementation in this module remains available
+behind ``mode="threads"`` for one release. Both modes produce
+byte-identical wire traffic (pinned by test_wire_zero_copy and the
+wire-opcode checker) and share the delivery contract above.
 """
 
 from __future__ import annotations
@@ -340,13 +351,15 @@ _SENDMSG_IOV = 64
 _COALESCE_MAX = 4096
 
 
-def _sendmsg_all(sock: socket.socket, parts) -> None:
-    """Send every buffer in ``parts`` without concatenating the large
-    ones — the scatter-gather complement of :func:`_recv_into`. A 4.3 MB
-    frame goes from the record's own panel memory to the kernel in one
-    hop; the old ``b"".join`` path paid a frame-sized copy per message.
-    Runs of tiny control parts are coalesced (see ``_COALESCE_MAX``)."""
-    bufs = []
+def _gather_parts(parts) -> List[memoryview]:
+    """Normalize a scatter-gather part list for sending: empty parts are
+    dropped, runs of tiny control parts (opcodes, lengths, record
+    headers) are coalesced up to ``_COALESCE_MAX``, frame-sized payloads
+    pass through as zero-copy memoryviews. Shared by the blocking
+    :func:`_sendmsg_all` sender and the event-loop server's non-blocking
+    outbound write queue (:mod:`psana_ray_tpu.transport.evloop`), so the
+    bytes on the wire are identical in both modes."""
+    bufs: List[memoryview] = []
     small: List[memoryview] = []
 
     def _flush_small():
@@ -367,6 +380,16 @@ def _sendmsg_all(sock: socket.socket, parts) -> None:
             _flush_small()
             bufs.append(m)
     _flush_small()
+    return bufs
+
+
+def _sendmsg_all(sock: socket.socket, parts) -> None:
+    """Send every buffer in ``parts`` without concatenating the large
+    ones — the scatter-gather complement of :func:`_recv_into`. A 4.3 MB
+    frame goes from the record's own panel memory to the kernel in one
+    hop; the old ``b"".join`` path paid a frame-sized copy per message.
+    Runs of tiny control parts are coalesced (see ``_COALESCE_MAX``)."""
+    bufs = _gather_parts(parts)
     if not hasattr(sock, "sendmsg"):  # platform fallback: copy-free per part
         for m in bufs:
             sock.sendall(m)
@@ -465,10 +488,63 @@ def _emit_relay_spans(items, t_send0: float) -> None:
         TRACER.span(trace.trace_id, SPAN_RELAY, t_send0, t_done)
 
 
+# -- server modes ----------------------------------------------------------
+# "evloop" (default): ONE selectors/epoll readiness loop serves every
+# connection through per-connection state machines — O(connections x
+# small struct) memory, thread count independent of connection count
+# (ISSUE 6; implementation in transport/evloop.py). "threads": the
+# legacy thread-per-connection server retained behind this flag for one
+# release (a thread + an ack-reader thread per streamed subscriber —
+# fine at tens of consumers, dead at thousands).
+DEFAULT_SERVER_MODE = "evloop"
+_SERVER_MODES = ("evloop", "threads")
+
+
+def _resolve_server_mode(mode: Optional[str]) -> str:
+    import os
+
+    m = mode or os.environ.get("PSANA_TCP_SERVER_MODE") or DEFAULT_SERVER_MODE
+    if m not in _SERVER_MODES:
+        raise ValueError(
+            f"unknown server mode {m!r}; expected one of {_SERVER_MODES}"
+        )
+    return m
+
+
+def _refuse_conn(conn: socket.socket, port: int, active: int, limit: int):
+    """Admission control: accept-then-refuse with a clean ``_ST_ERR``
+    payload instead of letting an accept storm OOM the relay. The
+    refused client's next ``_status()`` read surfaces it as a protocol
+    error immediately (no hang, no half-open connection)."""
+    FLIGHT.record("conn_refused", port=port, active=active, max_conns=limit)
+    try:
+        conn.send(_ST_ERR)
+    except OSError:
+        pass
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
 class TcpQueueServer:
     """Serve queues over TCP: one default queue plus any number of named
     queues that clients OPEN by (namespace, queue_name) — see the module
-    docstring. Start with ``serve_background()``."""
+    docstring. Start with ``serve_background()``.
+
+    Two serve modes (``mode=``, default :data:`DEFAULT_SERVER_MODE`,
+    overridable via ``PSANA_TCP_SERVER_MODE``):
+
+    - ``"evloop"`` — one epoll readiness loop, per-connection state
+      machines for all 16 opcodes, blocking waits as timer/deferred
+      state (:mod:`psana_ray_tpu.transport.evloop`). Scales to
+      thousands of streamed subscribers with O(1) threads.
+    - ``"threads"`` — the legacy thread-per-connection path, kept for
+      one release behind this flag.
+
+    Both speak the identical wire protocol and delivery contract;
+    ``max_conns`` (0 = unlimited) refuses connections past the limit
+    with a clean ``_ST_ERR`` instead of accepting unboundedly."""
 
     def __init__(
         self,
@@ -478,6 +554,8 @@ class TcpQueueServer:
         maxsize: int = 100,
         queue_factory=None,
         pool: Optional[BufferPool] = None,
+        mode: Optional[str] = None,
+        max_conns: int = 0,
     ):
         self.queue = queue if queue is not None else RingBuffer(maxsize)
         self._maxsize = maxsize
@@ -504,6 +582,9 @@ class TcpQueueServer:
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
+        self.mode = _resolve_server_mode(mode)
+        self.max_conns = int(max_conns)
+        self._loop = None  # evloop mode: the EventLoop driving this server
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
         """Get-or-create the named queue (the OPEN opcode server-side;
@@ -587,13 +668,27 @@ class TcpQueueServer:
                 pass
 
     def serve_background(self) -> "TcpQueueServer":
-        t = threading.Thread(target=self._accept_loop, daemon=True, name="tcp-queue-accept")
+        if self.mode == "evloop":
+            from psana_ray_tpu.transport.evloop import EventLoop
+
+            self._loop = EventLoop(self)
+            t = threading.Thread(
+                target=self._loop.run, daemon=True, name="tcp-evloop"
+            )
+        else:
+            t = threading.Thread(
+                target=self._accept_loop, daemon=True, name="tcp-queue-accept"
+            )
         t.start()
         self._accept_thread = t
         self._threads.append(t)
         return self
 
     def _accept_loop(self):
+        # legacy-path fix retained with the thread-per-connection mode:
+        # the 0.2 s accept timeout is the poll that lets this loop
+        # observe _stop (the evloop mode replaces it with readiness-
+        # driven accept + an explicit waker)
         try:
             self._sock.settimeout(0.2)
         except OSError:  # shutdown() closed the socket before we got here
@@ -612,6 +707,12 @@ class TcpQueueServer:
             with self._conns_lock:
                 self._conns = [c for c in self._conns if c.fileno() != -1]
                 self._conns.append(conn)
+                n_active = len(self._conns)
+            if self.max_conns and n_active > self.max_conns:
+                with self._conns_lock:
+                    self._conns.remove(conn)
+                _refuse_conn(conn, self.port, n_active - 1, self.max_conns)
+                continue
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -917,6 +1018,10 @@ class TcpQueueServer:
 
     def shutdown(self):
         self._stop.set()
+        # evloop mode: kick the selector out of its wait so _stop is
+        # observed immediately (no 0.2 s poll to lean on)
+        if self._loop is not None:
+            self._loop.wake()
         # join the accept loop BEFORE closing: a thread blocked inside
         # accept() keeps the listening socket alive past close(), so a
         # supervisor rebinding the same port immediately would race it

@@ -1,0 +1,29 @@
+"""Known-bad fixture for event-loop-blocking: blocking idioms reachable
+from the loop dispatch. Every marked line must flag."""
+
+import time
+
+
+def _sendmsg_all(sock, parts):
+    sock.sendall(parts)
+
+
+class EventLoop:
+    def run(self):
+        while True:
+            events = self._sel.select(0.1)
+            for key, mask in events:
+                self._dispatch(key.data)
+
+    def _dispatch(self, conn):
+        conn.handle()
+
+
+class _Conn:
+    def handle(self):
+        time.sleep(0.01)  # BAD: a bounded sleep still freezes every conn
+        self._lock.acquire()  # BAD: lock wait with no timeout
+        self.sock.sendall(b"1")  # BAD: blocking send on the loop thread
+        _sendmsg_all(self.sock, [b"x"])  # BAD: blocking send helper
+        self._cond.wait()  # BAD: unbounded Condition wait
+        self._reader.join()  # BAD: unbounded join

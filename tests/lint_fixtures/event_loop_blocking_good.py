@@ -1,0 +1,45 @@
+"""Known-good fixture for event-loop-blocking: the sanctioned
+non-blocking loop shapes — bounded select, incremental recv_into,
+write-queue sends, deferred waits as timer state, `with lock:`
+micro-sections, and deadline-bounded joins."""
+
+import heapq
+import time
+
+
+class EventLoop:
+    def run(self):
+        while not self._stop.is_set():
+            events = self._sel.select(self._select_timeout())
+            for key, mask in events:
+                self._dispatch(key.data)
+            self._fire_timers()
+
+    def _select_timeout(self):
+        if self._timers:
+            return max(0.0, self._timers[0][0] - time.monotonic())
+        return 0.5
+
+    def _dispatch(self, conn):
+        conn.on_readable()
+
+    def _fire_timers(self):
+        now = time.monotonic()
+        while self._timers and self._timers[0][0] <= now:
+            _, conn = heapq.heappop(self._timers)
+            conn.expire()
+
+
+class _Conn:
+    def on_readable(self):
+        try:
+            k = self.sock.recv_into(self._target)  # non-blocking socket
+        except BlockingIOError:
+            return
+        if k == 0:
+            raise ConnectionError("peer closed")
+        with self._lock:  # micro-section, not an explicit wait
+            self._got += k
+        self.out.append(self._target)  # deferred: write queue, not sendall
+        self._reader.join(timeout=2.0)  # bounded join is fine
+        self._cond.wait(timeout=0.2)  # bounded wait is fine

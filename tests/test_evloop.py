@@ -1,0 +1,499 @@
+"""Event-loop queue server (ISSUE 6): connection scaling with O(1)
+threads, admission control, bounded waits as timer state, and
+crash-redelivery parity across both server modes.
+
+The C10K-style scaling tests drive raw streamed-subscriber sockets off
+one client-side selector (a full TcpQueueClient per subscriber would
+measure client-object overhead, not the server): each subscriber speaks
+exactly the wire protocol — 'M' subscribe, push frames, cumulative 'K'
+acks, final 'F'.
+"""
+
+import selectors
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from psana_ray_tpu.records import FrameRecord
+from psana_ray_tpu.transport import EMPTY, TransportClosed
+from psana_ray_tpu.transport.codec import decode_payload
+from psana_ray_tpu.transport.evloop import EVLOOP
+from psana_ray_tpu.transport.ring import RingBuffer
+from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+
+def _mk(maxsize=256, **kw):
+    q = RingBuffer(maxsize)
+    srv = TcpQueueServer(q, host="127.0.0.1", **kw).serve_background()
+    return q, srv
+
+
+class SubscriberFleet:
+    """N raw streamed subscribers multiplexed on one client-side
+    selector; parses the push framing (status + seq:u64 + len:u32 +
+    payload) and acks cumulatively as it consumes."""
+
+    def __init__(self, port, n, window=8):
+        self.sel = selectors.DefaultSelector()
+        self.states = []
+        for _ in range(n):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(b"M" + struct.pack("<I", window))
+            s.setblocking(False)
+            st = {"sock": s, "buf": bytearray(), "delivered": 0, "closed": False}
+            self.sel.register(s, selectors.EVENT_READ, st)
+            self.states.append(st)
+
+    def drain(self, total, timeout=60.0, decode=True):
+        """Read until ``total`` frames arrived fleet-wide (or timeout);
+        returns the decoded items."""
+        out = []
+        deadline = time.monotonic() + timeout
+        while len(out) < total and time.monotonic() < deadline:
+            for key, _ in self.sel.select(timeout=0.25):
+                st = key.data
+                s = st["sock"]
+                try:
+                    data = s.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                if not data:
+                    st["closed"] = True
+                    self.sel.unregister(s)
+                    continue
+                st["buf"] += data
+                if self._parse(st, out, decode):
+                    s.sendall(b"K" + struct.pack("<Q", st["delivered"]))
+        return out
+
+    @staticmethod
+    def _parse(st, out, decode):
+        buf = st["buf"]
+        n_new = 0
+        while buf:
+            if buf[0:1] == b"X":
+                st["closed"] = True
+                del buf[:1]
+                continue
+            assert buf[0:1] == b"1", f"unexpected status {buf[0:1]!r}"
+            if len(buf) < 13:
+                break
+            seq, ln = struct.unpack_from("<QI", buf, 1)
+            if len(buf) < 13 + ln:
+                break
+            payload = bytes(buf[13 : 13 + ln])
+            out.append(decode_payload(payload) if decode else None)
+            st["delivered"] = seq
+            del buf[: 13 + ln]
+            n_new += 1
+        return n_new
+
+    def close(self, clean=True):
+        for st in self.states:
+            s = st["sock"]
+            try:
+                if clean and not st["closed"]:
+                    s.setblocking(True)
+                    s.sendall(
+                        b"K" + struct.pack("<Q", st["delivered"]) + b"F"
+                    )
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.sel.close()
+
+
+def _rss_kb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+class TestEventLoopBasics:
+    def test_evloop_is_the_default_mode(self):
+        q, srv = _mk()
+        try:
+            assert srv.mode == "evloop"
+            assert srv._loop is not None
+        finally:
+            srv.shutdown()
+
+    def test_threads_mode_available_behind_flag(self):
+        q, srv = _mk(mode="threads")
+        try:
+            assert srv.mode == "threads" and srv._loop is None
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            assert c.put({"x": 1}) and c.get() == {"x": 1}
+            c.disconnect()
+        finally:
+            srv.shutdown()
+
+    def test_bounded_wait_is_timer_state_not_a_thread(self):
+        """'D' against an empty queue must honor its deadline through the
+        timer heap, and wake promptly when another TCP client enqueues
+        (in-loop wake, no poll tick on the wire)."""
+        q, srv = _mk()
+        try:
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            t0 = time.monotonic()
+            assert c.get_batch(4, timeout=0.3) == []
+            assert time.monotonic() - t0 >= 0.25
+            prod = TcpQueueClient("127.0.0.1", srv.port)
+            threading.Timer(0.15, lambda: prod.put({"i": 1})).start()
+            t0 = time.monotonic()
+            out = c.get_batch(4, timeout=5.0)
+            assert out == [{"i": 1}]
+            assert time.monotonic() - t0 < 1.0  # woken, not expired
+            prod.disconnect()
+            c.disconnect()
+        finally:
+            srv.shutdown()
+
+    def test_in_process_put_wakes_waiter_via_listener(self):
+        """A direct RingBuffer.put from another thread must reach a
+        parked 'D' waiter through the change listener + waker pipe."""
+        q, srv = _mk()
+        try:
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            threading.Timer(0.15, lambda: q.put({"j": 2})).start()
+            t0 = time.monotonic()
+            out = c.get_batch(4, timeout=5.0)
+            assert out == [{"j": 2}]
+            assert time.monotonic() - t0 < 1.0
+            c.disconnect()
+        finally:
+            srv.shutdown()
+
+
+class TestAdmissionControl:
+    @pytest.mark.parametrize("mode", ["evloop", "threads"])
+    def test_max_conns_refuses_with_protocol_error(self, mode):
+        q, srv = _mk(mode=mode, max_conns=2)
+        try:
+            refused0 = EVLOOP.stats()["refused_total"]
+            c1 = TcpQueueClient("127.0.0.1", srv.port)
+            c2 = TcpQueueClient("127.0.0.1", srv.port)
+            assert c1.put({"a": 1}) and c2.size() == 1  # both admitted
+            c3 = TcpQueueClient("127.0.0.1", srv.port, reconnect_tries=1,
+                                reconnect_base_s=0.01)
+            with pytest.raises((RuntimeError, TransportClosed)):
+                c3.size()  # the refusal 'E' surfaces on first use
+            if mode == "evloop":
+                assert EVLOOP.stats()["refused_total"] > refused0
+            # admitted clients keep working through the refusal
+            assert c2.get() == {"a": 1}
+            c1.disconnect()
+            c2.disconnect()
+        finally:
+            srv.shutdown()
+
+    def test_slots_free_after_disconnect(self):
+        q, srv = _mk(max_conns=1)
+        try:
+            c1 = TcpQueueClient("127.0.0.1", srv.port)
+            assert c1.size() == 0
+            c1.disconnect()
+            deadline = time.monotonic() + 5.0
+            # the slot frees once the server observes the close
+            while time.monotonic() < deadline:
+                c2 = TcpQueueClient("127.0.0.1", srv.port)
+                try:
+                    assert c2.size() == 0
+                    break
+                except RuntimeError:
+                    c2.disconnect()
+                    time.sleep(0.05)
+            else:
+                pytest.fail("slot never freed after clean disconnect")
+            c2.disconnect()
+        finally:
+            srv.shutdown()
+
+
+class TestRedeliveryModeMatrix:
+    """The at-least-once contract must hold identically in both server
+    modes: kill a streaming consumer mid-window and exactly the unacked
+    tail redelivers."""
+
+    @pytest.mark.parametrize("mode", ["evloop", "threads"])
+    def test_kill_after_partial_ack_redelivers_exactly_the_tail(self, mode):
+        import numpy as np
+
+        q, srv = _mk(maxsize=64, mode=mode)
+        try:
+            for i in range(10):
+                q.put(FrameRecord(0, i, np.full((1, 8, 8), float(i), np.float32), 1.0))
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            c.stream_open(window=32)
+            first = []
+            deadline = time.monotonic() + 5.0
+            while len(first) < 6 and time.monotonic() < deadline:
+                first.extend(c.get_batch_stream(6 - len(first), timeout=1.0))
+            assert len(first) == 6
+            # coming back acks the previous six
+            second = []
+            while not second and time.monotonic() < deadline:
+                second = c.get_batch_stream(1, timeout=1.0)
+            assert len(second) == 1 and second[0].event_idx == 6
+            c._sock.close()  # crash with seq 7..10 un-ACKed
+            deadline = time.monotonic() + 5.0
+            while q.size() < 4 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            redelivered = sorted(
+                r.event_idx for r in [q.get() for _ in range(q.size())]
+            )
+            # 0..5 acked (never redelivered); 6 delivered-but-unacked
+            # (duplicate); 7..9 undelivered
+            assert redelivered == [6, 7, 8, 9]
+        finally:
+            srv.shutdown()
+
+    @pytest.mark.parametrize("mode", ["evloop", "threads"])
+    def test_unacked_get_requeues_on_death(self, mode):
+        q, srv = _mk(maxsize=8, mode=mode)
+        try:
+            q.put({"k": 5})
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            assert c.get() == {"k": 5} and q.size() == 0
+            c._sock.close()  # no next request, no BYE
+            deadline = time.monotonic() + 5.0
+            while q.size() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert q.size() == 1 and q.get() == {"k": 5}
+        finally:
+            srv.shutdown()
+
+
+class TestConnectionScaling:
+    """Tier-1 smoke: >=200 concurrent streamed subscribers on loopback,
+    every frame delivered exactly once (no crashes -> no duplicates per
+    the at-least-once contract), server thread count O(1)."""
+
+    N_SUBS = 200
+    N_FRAMES = 600
+
+    def test_200_streamed_subscribers_exactly_once_O1_threads(self):
+        q, srv = _mk(maxsize=256)
+        fleet = None
+        prod = None
+        try:
+            threads_before = threading.active_count()
+            fleet = SubscriberFleet(srv.port, self.N_SUBS, window=8)
+            # 200 live connections added ZERO server threads (the loop
+            # thread already existed) — the whole point of the rewrite
+            assert threading.active_count() == threads_before
+            prod = TcpQueueClient("127.0.0.1", srv.port)
+
+            def produce():
+                for i in range(self.N_FRAMES):
+                    assert prod.put_wait({"i": i}, timeout=60.0)
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            items = fleet.drain(self.N_FRAMES, timeout=90.0)
+            t.join(timeout=10.0)
+            assert len(items) == self.N_FRAMES
+            # exactly once: all present, none duplicated
+            assert sorted(d["i"] for d in items) == list(range(self.N_FRAMES))
+            assert threading.active_count() == threads_before
+        finally:
+            if fleet is not None:
+                fleet.close()
+            if prod is not None:
+                prod.disconnect()
+            srv.shutdown()
+
+    @pytest.mark.slow
+    def test_1000_subscribers_no_collapse_flat_memory(self):
+        """ISSUE 6 acceptance shape (the judged numbers live in the
+        bench row): 1000 concurrent streamed subscribers deliver every
+        frame exactly once, per-connection RSS growth stays under 64 KB,
+        thread count stays flat, and throughput does not collapse
+        relative to a 16-subscriber run on the same server config."""
+        n_frames = 3000
+
+        def run(n_subs):
+            q, srv = _mk(maxsize=512)
+            fleet = prod = None
+            try:
+                rss0 = _rss_kb()
+                fleet = SubscriberFleet(srv.port, n_subs, window=8)
+                rss_per_conn_kb = (_rss_kb() - rss0) / n_subs
+                prod = TcpQueueClient("127.0.0.1", srv.port)
+                threads0 = threading.active_count()
+
+                def produce():
+                    for i in range(n_frames):
+                        assert prod.put_wait({"i": i}, timeout=120.0)
+
+                t = threading.Thread(target=produce, daemon=True)
+                t0 = time.monotonic()
+                t.start()
+                items = fleet.drain(n_frames, timeout=240.0)
+                dt = time.monotonic() - t0
+                t.join(timeout=10.0)
+                assert sorted(d["i"] for d in items) == list(range(n_frames))
+                assert threading.active_count() == threads0
+                return n_frames / dt, rss_per_conn_kb
+            finally:
+                if fleet is not None:
+                    fleet.close()
+                if prod is not None:
+                    prod.disconnect()
+                srv.shutdown()
+
+        fps_16, _ = run(16)
+        fps_1000, rss_per_conn = run(1000)
+        assert rss_per_conn <= 64.0, (
+            f"per-connection RSS growth {rss_per_conn:.1f} KB > 64 KB"
+        )
+        # no-collapse: generous floor for a noisy shared 2-core box; the
+        # bench row records the honest ratio (acceptance: >=0.8 there)
+        assert fps_1000 >= 0.5 * fps_16, (
+            f"fps collapsed: {fps_1000:.0f} at 1000 subs vs {fps_16:.0f} at 16"
+        )
+
+
+class TestParkedLiveness:
+    def test_dead_client_while_parked_no_pipelined_bytes_drops_frame(self):
+        """EOF detection while a 'W' enqueue is parked (no pipelined
+        bytes): the event loop keeps read interest armed and kills the
+        connection the moment the peer closes — the parked frame is
+        dropped, never enqueued late (the windowed-put resend covers it
+        on a real reconnect). Parity with the threaded _peer_hung_up."""
+        import struct as _struct
+
+        from psana_ray_tpu.transport.codec import encode_payload
+
+        q, srv = _mk(maxsize=1)
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+
+            def w(seq, obj):
+                payload = encode_payload(obj)
+                s.sendall(
+                    b"W" + _struct.pack("<QI", seq, len(payload)) + payload
+                )
+
+            w(1, {"i": 1})  # fills the queue (ack written, never read)
+            w(2, {"i": 2})  # parks server-side: queue full
+            time.sleep(0.3)
+            s.close()  # dies mid-wait, nothing further pipelined
+            time.sleep(0.6)
+            assert q.get() == {"i": 1}  # frees the slot
+            assert q.get_wait(timeout=1.0) is EMPTY  # frame 2 dropped
+        finally:
+            srv.shutdown()
+
+    def test_dead_pipelining_producer_reaped_not_pinned(self):
+        """Review fix (recurring liveness probe): a windowed producer
+        that pipelines MORE requests and then dies while its enqueue is
+        parked pauses the server's reads — the first MSG_PEEK pause must
+        not end liveness checking forever. Contract parity with the
+        threaded server (verified A/B): the parked frame may enqueue
+        once space frees (an at-least-once DUPLICATE — its reconnect
+        resend would carry it anyway; duplicates allowed, holes never),
+        the never-read pipelined frame must NOT appear, and the dead
+        connection is reaped — not pinned with its lease forever."""
+        import struct as _struct
+
+        from psana_ray_tpu.transport.codec import encode_payload
+
+        q, srv = _mk(maxsize=1)
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+
+            def w(seq, obj):
+                payload = encode_payload(obj)
+                s.sendall(
+                    b"W" + _struct.pack("<QI", seq, len(payload)) + payload
+                )
+
+            w(1, {"i": 1})  # fills the queue
+            w(2, {"i": 2})  # parks server-side: queue full
+            w(3, {"i": 3})  # pipelined bytes -> server pauses reads
+            time.sleep(0.4)
+            conns_live = EVLOOP.stats()["connections"]
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                _struct.pack("ii", 1, 0),
+            )
+            s.close()
+            time.sleep(1.2)  # > 2 probe intervals
+            assert q.get() == {"i": 1}  # frees the slot
+            # frame 2 may arrive as a duplicate (same as threads mode);
+            # frame 3 must never complete its read
+            seen = []
+            item = q.get_wait(timeout=2.0)
+            while item is not EMPTY:
+                seen.append(item)
+                item = q.get_wait(timeout=0.5)
+            assert {"i": 3} not in seen, seen
+            # and the dead connection is reaped, not pinned: the write
+            # of frame 2's ack (or the probe) discovers the death
+            deadline = time.monotonic() + 5.0
+            while (
+                EVLOOP.stats()["connections"] >= conns_live
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert EVLOOP.stats()["connections"] < conns_live
+        finally:
+            srv.shutdown()
+
+
+class TestStreamFairness:
+    def test_two_subscribers_share_one_queue_without_starvation(self):
+        q, srv = _mk(maxsize=128)
+        fleet = None
+        try:
+            fleet = SubscriberFleet(srv.port, 2, window=4)
+            prod = TcpQueueClient("127.0.0.1", srv.port)
+            for i in range(64):
+                assert prod.put_wait({"i": i}, timeout=30.0)
+            items = fleet.drain(64, timeout=30.0)
+            assert sorted(d["i"] for d in items) == list(range(64))
+            # round-robin pump: both connections actually got frames
+            counts = [st["delivered"] for st in fleet.states]
+            assert all(c > 0 for c in counts), counts
+            prod.disconnect()
+        finally:
+            if fleet is not None:
+                fleet.close()
+            srv.shutdown()
+
+
+class TestLoopTelemetry:
+    def test_evloop_gauges_register_and_count(self):
+        from psana_ray_tpu.obs.registry import snapshot_source
+
+        q, srv = _mk()
+        try:
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            assert c.put({"x": 1}) and c.get() == {"x": 1}
+            s = EVLOOP.stats()
+            assert s["connections"] >= 1
+            assert s["accepted_total"] >= 1
+            assert s["loops_total"] >= 1
+            # registry source protocol: the gauges scrape as a dict
+            # (the loop registers itself as the 'evloop' source on the
+            # process default registry at first start)
+            snap = snapshot_source(EVLOOP)
+            assert snap["connections_peak"] >= 1
+            assert "dispatch_ms_max" in snap and "timer_lag_ms_max" in snap
+            c.disconnect()
+            deadline = time.monotonic() + 5.0
+            while EVLOOP.stats()["connections"] > s["connections"] - 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            srv.shutdown()

@@ -316,6 +316,59 @@ def test_real_stream_reader_is_reachable_and_clean():
     assert "get_batch_stream" in SEED_EDGES["batches_from_queue"]
 
 
+def test_event_loop_checker_roots_resolve_and_real_loop_is_clean():
+    """ISSUE 6 satellite: the event-loop-blocking checker must root at
+    the REAL loop dispatch (EventLoop.run) and find the shipped loop
+    clean — its sends go through the non-blocking write queue, its reads
+    through the incremental recv_into state machine, its waits through
+    the timer heap."""
+    evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    result = run_lint(paths=[evloop, tcp], checkers=["event-loop-blocking"])
+    assert not result.findings, result.findings
+    from psana_ray_tpu.lint.checkers.evblocking import ROOTS
+
+    assert "EventLoop.run" in ROOTS
+
+
+def test_event_loop_checker_flags_a_smuggled_sleep_in_loop_code():
+    """A sleep (or blocking send helper) smuggled into code the loop
+    dispatch reaches must flag even through attribute-call edges."""
+    import textwrap
+
+    path = FIXTURES / "_tmp_evloop_sleep.py"
+    path.write_text(textwrap.dedent("""
+        import time
+
+
+        class EventLoop:
+            def run(self):
+                while True:
+                    for key, mask in self._sel.select(0.1):
+                        key.data.on_readable()
+
+
+        class _Conn:
+            def on_readable(self):
+                self.queue.drain_slowly()
+
+
+        class SlowQueue:
+            def drain_slowly(self):
+                time.sleep(0.05)  # must flag: freezes every connection
+    """))
+    try:
+        result = run_lint(paths=[path], checkers=["event-loop-blocking"])
+        hits = [
+            f
+            for f in result.findings
+            if "time.sleep" in f.message and "drain_slowly" in f.message
+        ]
+        assert hits, result.findings
+    finally:
+        path.unlink()
+
+
 def test_duration_covers_parsing_not_just_checking():
     # the <5s budget must measure what an operator waits for: a full run
     # spends most of its time reading+parsing, which duration_s includes

@@ -1,0 +1,51 @@
+"""Sharded queue cluster (ISSUE 7): N queue servers, one logical service.
+
+- :mod:`~psana_ray_tpu.cluster.hashring` — rendezvous-hash partition
+  placement (``PartitionMap``) and deterministic group assignment;
+- :mod:`~psana_ray_tpu.cluster.coordinator` — server-side consumer-group
+  registry (membership, generations, fencing) behind the 'N' RPC;
+- :mod:`~psana_ray_tpu.cluster.group` — the client half of a member's
+  generation-fenced lease;
+- :mod:`~psana_ray_tpu.cluster.client` — ``ClusterClient``, the routing
+  client that presents the whole cluster as one transport-contract
+  queue (``cluster://host:port,host:port`` addresses).
+
+``ClusterClient`` is exported lazily: ``transport.tcp`` imports the
+coordinator from this package (the server hosts group state), while the
+client imports ``transport.tcp`` — eager re-export here would close
+that cycle during interpreter import.
+"""
+
+from psana_ray_tpu.cluster.coordinator import GroupRegistry, coordinator_address  # noqa: F401
+from psana_ray_tpu.cluster.hashring import (  # noqa: F401
+    PartitionMap,
+    assign_group_partitions,
+    partition_owner,
+    partition_queue_name,
+)
+from psana_ray_tpu.cluster.telemetry import CLUSTER  # noqa: F401
+
+__all__ = [
+    "CLUSTER",
+    "ClusterClient",
+    "GroupRegistry",
+    "GroupSession",
+    "PartitionMap",
+    "assign_group_partitions",
+    "coordinator_address",
+    "parse_cluster_address",
+    "partition_owner",
+    "partition_queue_name",
+]
+
+
+def __getattr__(name):
+    if name in ("ClusterClient", "parse_cluster_address"):
+        from psana_ray_tpu.cluster import client as _client
+
+        return getattr(_client, name)
+    if name == "GroupSession":
+        from psana_ray_tpu.cluster.group import GroupSession
+
+        return GroupSession
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

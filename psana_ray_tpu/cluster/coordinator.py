@@ -1,0 +1,206 @@
+"""Consumer-group coordinator state: membership, generations, fencing.
+
+The cluster's one piece of shared control-plane state. It lives on a
+queue server (by convention the FIRST address on the cluster list — "the
+first server on the ring") behind the group RPC opcode ('N',
+:mod:`psana_ray_tpu.transport.tcp`): members join/heartbeat/leave a
+named group, and the registry answers every request with the group's
+current ``generation`` and sorted member list. Partition ASSIGNMENT is
+not negotiated here — it is the pure function
+:func:`psana_ray_tpu.cluster.hashring.assign_group_partitions` of the
+membership list, so agreeing on membership IS agreeing on assignment.
+
+Generation fencing: every mutation bumps ``generation`` (join, leave,
+liveness expiry), and requests that carry a ``generation`` older than
+current are answered ``fenced`` instead of applied — a member that
+missed a rebalance cannot commit drain progress or refresh its lease
+against an assignment it no longer holds. The data-plane half of the
+fence is the transport's existing crash-redelivery: a revoked member's
+partition connections die or unsubscribe, and everything it had
+in-flight re-enqueues at the queue head for the new owner
+(at-least-once, duplicates possible, loss never).
+
+Liveness: members must heartbeat within ``session_timeout_s``; every
+request sweeps expired members first (no timer thread — the registry is
+passive state behind the RPC). Deliberately NOT persistent: a
+coordinator restart empties the registry, members observe the unknown-
+group answer and rejoin — generations restart, which is safe because a
+fresh coordinator also has no stale state to fence against.
+
+This module is stdlib-only (no transport imports): the server side of
+the RPC hands it decoded JSON dicts and sends back what it returns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+# default member-lease length: generous against stop-the-world pauses
+# (the client beats from a background thread — default 1 s — so only a
+# frozen PROCESS misses this many), yet a dead member's partitions
+# still reassign within seconds-not-minutes
+DEFAULT_SESSION_TIMEOUT_S = 10.0
+
+
+class _Group:
+    __slots__ = ("generation", "members", "drained", "n_partitions")
+
+    def __init__(self):
+        self.generation = 0
+        self.members: Dict[str, float] = {}  # member_id -> last_seen mono
+        self.drained: set = set()  # partitions committed fully drained
+        self.n_partitions = 0
+
+
+class GroupRegistry:
+    """Server-side consumer-group state behind the 'N' RPC.
+
+    Request/response dicts (JSON on the wire):
+
+    - ``{"op": "join", "group": g, "member": m, "n_partitions": P}`` ->
+      ``{"ok": True, "generation": G, "members": [...], "drained": [...]}``
+      (idempotent for a member already present: re-join after a fence
+      bumps the generation only if membership actually changed)
+    - ``{"op": "heartbeat", "group": g, "member": m, "generation": G}``
+      -> same shape; ``{"ok": False, "fenced": True, ...}`` when ``G``
+      is stale or the member expired (the caller must re-join and
+      recompute its assignment before touching its partitions again)
+    - ``{"op": "leave", "group": g, "member": m}`` -> ack (generation
+      bumps; the survivors' next heartbeat observes the rebalance)
+    - ``{"op": "drained", "group": g, "member": m, "generation": G,
+      "partition": p}`` -> generation-FENCED commit that partition ``p``
+      saw its complete EOS tally — group-wide state, so a partition
+      drained before a rebalance stays drained for the new assignee and
+      the group emits exactly one aggregated end-of-stream
+    - ``{"op": "info", "group": g}`` -> current state, no mutation
+    """
+
+    def __init__(self, session_timeout_s: float = DEFAULT_SESSION_TIMEOUT_S):
+        self.session_timeout_s = session_timeout_s
+        self._lock = threading.Lock()
+        self._groups: Dict[str, _Group] = {}  # guarded-by: _lock
+
+    # -- the RPC entry point ----------------------------------------------
+    def handle(self, req: dict) -> dict:
+        op = req.get("op")
+        group = req.get("group")
+        if not isinstance(group, str) or not group:
+            return {"ok": False, "error": "missing group"}
+        member = req.get("member")
+        with self._lock:
+            g = self._groups.get(group)
+            if op == "join":
+                if g is None:
+                    g = self._groups[group] = _Group()
+                self._sweep(g)
+                # validate BEFORE enrolling: a refused join must leave
+                # no trace — enrolling first would hand a misconfigured
+                # (and client-side crashed) member a partition share it
+                # will never drain, starving those partitions for a full
+                # lease, and fence every healthy member for nothing
+                n_parts = int(req.get("n_partitions") or 0)
+                if n_parts > 0 and g.n_partitions and g.n_partitions != n_parts:
+                    return {
+                        "ok": False,
+                        "error": f"group {group!r} was created with "
+                        f"n_partitions={g.n_partitions}, not {n_parts}",
+                    }
+                if not g.members and g.drained:
+                    # a join into an EMPTY group starts a new stream
+                    # epoch: stale drained state from a previous run
+                    # reusing this group name would otherwise hand the
+                    # new members an instant (bogus) end-of-stream and
+                    # silently strand every frame of the new stream
+                    g.drained.clear()
+                    g.generation += 1
+                if member not in g.members:
+                    g.generation += 1
+                g.members[member] = time.monotonic()
+                if n_parts > 0:
+                    g.n_partitions = n_parts
+                return self._state(g, ok=True)
+            if g is None:
+                return {"ok": False, "unknown_group": True}
+            self._sweep(g)
+            if op == "heartbeat":
+                return self._fenced_touch(g, member, req)
+            if op == "leave":
+                if member in g.members:
+                    del g.members[member]
+                    g.generation += 1
+                return self._state(g, ok=True)
+            if op == "drained":
+                out = self._fenced_touch(g, member, req)
+                if out.get("ok"):
+                    p = int(req.get("partition", -1))
+                    if 0 <= p and (not g.n_partitions or p < g.n_partitions):
+                        g.drained.add(p)
+                    return self._state(g, ok=True)
+                return out
+            if op == "info":
+                return self._state(g, ok=True)
+            return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # -- internals (caller holds _lock) -----------------------------------
+    def _sweep(self, g: _Group) -> None:
+        """Expire members whose lease lapsed; each expiry is a
+        membership change, so the generation bumps (survivors observe
+        the rebalance on their next heartbeat)."""
+        # guarded-by-caller: _lock
+        cutoff = time.monotonic() - self.session_timeout_s
+        dead = [m for m, seen in g.members.items() if seen < cutoff]
+        for m in dead:
+            del g.members[m]
+        if dead:
+            g.generation += 1
+
+    def _fenced_touch(self, g: _Group, member, req: dict) -> dict:
+        """Refresh ``member``'s lease iff its generation is current and
+        it is still a member — the fence that makes a revoked member's
+        post-rebalance writes rejections, not corruption."""
+        # guarded-by-caller: _lock
+        gen = req.get("generation")
+        if member not in g.members or gen != g.generation:
+            return self._state(g, ok=False, fenced=True)
+        g.members[member] = time.monotonic()
+        return self._state(g, ok=True)
+
+    def _state(self, g: _Group, ok: bool, fenced: bool = False) -> dict:
+        # guarded-by-caller: _lock
+        out = {
+            "ok": ok,
+            "generation": g.generation,
+            "members": sorted(g.members),
+            "drained": sorted(g.drained),
+            "n_partitions": g.n_partitions,
+        }
+        if fenced:
+            out["fenced"] = True
+        return out
+
+    # -- observability ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                group: {
+                    "generation": g.generation,
+                    "members": len(g.members),
+                    "drained": len(g.drained),
+                    "n_partitions": g.n_partitions,
+                }
+                for group, g in self._groups.items()
+            }
+
+
+def coordinator_address(servers) -> str:
+    """The convention clients use to find the registry: the first
+    address of the cluster list (static config; a dead coordinator means
+    group ops fail loudly rather than split-brain — the data plane keeps
+    flowing on the surviving servers)."""
+    servers = list(servers)
+    if not servers:
+        raise ValueError("empty cluster server list")
+    return servers[0]

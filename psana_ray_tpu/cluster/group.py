@@ -1,0 +1,163 @@
+"""Client half of consumer groups: one member's generation-fenced lease.
+
+A :class:`GroupSession` owns a member's view of its group — current
+generation, membership list, and the partitions the deterministic
+assignment function gives THIS member — and keeps it fresh against the
+coordinator (:mod:`psana_ray_tpu.cluster.coordinator`) through
+rate-limited heartbeats. It never touches sockets itself: the owning
+:class:`~psana_ray_tpu.cluster.client.ClusterClient` injects an
+``rpc(payload) -> dict`` callable (the 'N' opcode on the coordinator
+server), so this module stays transport-free and directly testable.
+
+The fencing contract, client side: every mutating request carries the
+generation this member last observed. A ``fenced`` answer means the
+group moved on without us (we missed a rebalance, or our lease expired)
+— the session REJOINS before anything else, and the caller must
+recompute its assignment and release revoked partitions before reading
+them again. In-flight frames on a revoked partition follow the
+transport's requeue-at-head contract (the new owner redelivers them),
+so a fence costs duplicates at worst, never loss.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Optional, Tuple
+
+from psana_ray_tpu.cluster.hashring import assign_group_partitions
+from psana_ray_tpu.cluster.telemetry import CLUSTER
+
+
+class GroupSession:
+    """One member's lease on a named consumer group.
+
+    Thread-safe BY ITSELF (its own lock guards the absorbed state and
+    the rate limiter; the wire exchange runs outside it): the owning
+    client's background keepalive thread beats WITHOUT the cluster-wide
+    lock, so a coordinator round trip never stalls the data path, and
+    the drain loop's reads (``generation``/``assigned``/``drained``)
+    stay consistent against a concurrent heartbeat."""
+
+    def __init__(
+        self,
+        rpc: Callable[[dict], dict],
+        group: str,
+        member_id: Optional[str] = None,
+        n_partitions: int = 0,
+        heartbeat_s: float = 1.0,
+    ):
+        self.rpc = rpc
+        self.group = group
+        self.member_id = member_id or f"member-{uuid.uuid4().hex[:12]}"
+        self.n_partitions = n_partitions
+        self.heartbeat_s = heartbeat_s
+        self._slock = threading.Lock()
+        self.generation = -1  # guarded-by: _slock
+        self.members: Tuple[str, ...] = ()  # guarded-by: _slock
+        self.drained: frozenset = frozenset()  # guarded-by: _slock
+        self._last_beat = 0.0  # guarded-by: _slock
+
+    # -- membership --------------------------------------------------------
+    def join_group(self) -> bool:
+        """(Re)join: the answer is never fenced — join is how a fenced
+        member gets current again. Returns True when the generation (and
+        therefore possibly the assignment) changed."""
+        resp = self.rpc({
+            "op": "join",
+            "group": self.group,
+            "member": self.member_id,
+            "n_partitions": self.n_partitions,
+        })
+        if not resp.get("ok"):
+            raise RuntimeError(f"group join refused: {resp}")
+        with self._slock:
+            self._last_beat = time.monotonic()
+        return self._absorb(resp)
+
+    def leave(self) -> None:
+        try:
+            self.rpc({"op": "leave", "group": self.group, "member": self.member_id})
+        except Exception:  # noqa: BLE001 — leaving is best-effort; the lease expires
+            pass
+
+    def maybe_heartbeat(self) -> bool:
+        """Rate-limited lease refresh. Returns True when the observed
+        generation changed (the caller must rebalance its partition set
+        before its next read). A fenced answer rejoins immediately."""
+        with self._slock:
+            now = time.monotonic()
+            if now - self._last_beat < self.heartbeat_s:
+                return False
+            self._last_beat = now
+            gen = self.generation
+        resp = self.rpc({
+            "op": "heartbeat",
+            "group": self.group,
+            "member": self.member_id,
+            "generation": gen,
+        })
+        if resp.get("fenced") or resp.get("unknown_group"):
+            CLUSTER.fenced_op()
+            return self.join_group()
+        return self._absorb(resp)
+
+    def commit_drained(self, partition: int) -> bool:
+        """Generation-fenced commit that ``partition`` completed its EOS
+        tally — group-wide, so the drain survives rebalances. Returns
+        False (after rejoining) when fenced: the caller no longer owns
+        the partition and must NOT treat its local tally as authoritative
+        (the new owner re-reads the markers and commits itself)."""
+        with self._slock:
+            gen = self.generation
+        resp = self.rpc({
+            "op": "drained",
+            "group": self.group,
+            "member": self.member_id,
+            "generation": gen,
+            "partition": partition,
+        })
+        if resp.get("fenced") or resp.get("unknown_group"):
+            CLUSTER.fenced_op()
+            self.join_group()
+            return False
+        self._absorb(resp)
+        return bool(resp.get("ok"))
+
+    # -- assignment --------------------------------------------------------
+    def assigned(self) -> Tuple[int, ...]:
+        """This member's partitions under the current generation — the
+        pure deterministic function of the membership list, identical on
+        every member (:func:`assign_group_partitions`)."""
+        with self._slock:
+            members = self.members
+        if not members:
+            return ()
+        return assign_group_partitions(
+            members, self.member_id, self.n_partitions
+        )
+
+    def all_drained(self) -> bool:
+        """Group-wide drain state: every partition committed drained —
+        the aggregated end-of-stream condition for the whole group."""
+        with self._slock:
+            return (
+                self.n_partitions > 0
+                and len(self.drained) >= self.n_partitions
+            )
+
+    def _absorb(self, resp: dict) -> bool:
+        with self._slock:
+            gen = int(resp.get("generation", self.generation))
+            if gen < self.generation:
+                # a slow response raced a newer one (heartbeat thread vs
+                # drain-path commit): never regress — generations only
+                # move forward, that is what makes the fence a fence
+                return False
+            self.members = tuple(resp.get("members", self.members))
+            self.drained = frozenset(int(p) for p in resp.get("drained", ()))
+            if gen != self.generation:
+                self.generation = gen
+                return True
+            return False

@@ -1,0 +1,126 @@
+"""Partition placement: rendezvous (highest-random-weight) hashing.
+
+A logical queue becomes ``n_partitions`` partitions; each partition
+``(queue_name, p)`` lives on exactly ONE queue server as an ordinary
+named queue (:func:`partition_queue_name` — the OPEN opcode needs no
+new wire surface for placement). Placement is rendezvous hashing over
+the live server set: every (queue, partition) pair scores every server
+with a keyed hash and the highest score owns the partition.
+
+Rendezvous hashing gives the stability property the cluster needs for
+free: when a server joins, the only partitions that move are those the
+NEW server now wins (~1/N of them in expectation); when a server dies,
+only ITS partitions move (each to its runner-up server) — nothing else
+is reshuffled. Every client computes the same map from the same live
+set with no coordination, so producers and consumers agree on placement
+as long as they agree on membership (static address list, deaths
+detected via the transport's reconnect-exhaustion signal).
+
+The map carries a ``version`` so observability and the rebalance logic
+can talk about "the map changed" without diffing assignments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Sequence, Tuple
+
+
+def partition_queue_name(queue_name: str, partition: int) -> str:
+    """The server-side named queue hosting one partition. A plain name
+    under the existing OPEN opcode: partition 3 of ``shared_queue`` is
+    the named queue ``shared_queue#p3`` on whichever server owns it."""
+    return f"{queue_name}#p{partition}"
+
+
+def _score(server: str, queue_name: str, partition: int) -> int:
+    """Keyed rendezvous score: deterministic across processes and runs
+    (hashlib, not hash() — PYTHONHASHSEED must not move partitions)."""
+    key = f"{server}|{queue_name}|{partition}".encode()
+    return int.from_bytes(hashlib.blake2b(key, digest_size=8).digest(), "little")
+
+
+def partition_owner(
+    servers: Sequence[str], queue_name: str, partition: int
+) -> str:
+    """The live server owning ``(queue_name, partition)`` — the highest
+    rendezvous score. Ties are impossible in practice (64-bit scores);
+    deterministic anyway via the (score, server) tuple order."""
+    if not servers:
+        raise ValueError("no live servers to place partitions on")
+    return max(servers, key=lambda s: (_score(s, queue_name, partition), s))
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionMap:
+    """One immutable placement of a queue's partitions over a live
+    server set. ``assignments[p]`` is the owning server's ``host:port``
+    string. New maps come from :meth:`compute` (initial) and
+    :meth:`recompute` (membership change: version bumps, only the
+    rendezvous-forced partitions move)."""
+
+    queue_name: str
+    n_partitions: int
+    servers: Tuple[str, ...]  # the live set this map was computed over
+    version: int
+    assignments: Dict[int, str]
+
+    @classmethod
+    def compute(
+        cls,
+        servers: Sequence[str],
+        queue_name: str,
+        n_partitions: int,
+        version: int = 1,
+    ) -> "PartitionMap":
+        if n_partitions <= 0:
+            raise ValueError("n_partitions must be positive")
+        live = tuple(dict.fromkeys(servers))  # order-preserving dedup
+        return cls(
+            queue_name=queue_name,
+            n_partitions=n_partitions,
+            servers=live,
+            version=version,
+            assignments={
+                p: partition_owner(live, queue_name, p)
+                for p in range(n_partitions)
+            },
+        )
+
+    def recompute(self, servers: Sequence[str]) -> "PartitionMap":
+        """The next map over a changed live set (server died / joined):
+        version + 1, same queue and partition count."""
+        return self.compute(
+            servers, self.queue_name, self.n_partitions, self.version + 1
+        )
+
+    def partitions_on(self, server: str) -> Tuple[int, ...]:
+        return tuple(
+            p for p, s in sorted(self.assignments.items()) if s == server
+        )
+
+    def moved_from(self, prev: "PartitionMap") -> Tuple[int, ...]:
+        """Partitions whose owner differs from ``prev`` — the rebalance
+        delta a membership change actually forces."""
+        return tuple(
+            p
+            for p in range(self.n_partitions)
+            if self.assignments.get(p) != prev.assignments.get(p)
+        )
+
+
+def assign_group_partitions(
+    members: Sequence[str], member_id: str, n_partitions: int
+) -> Tuple[int, ...]:
+    """Deterministic, disjoint, exhaustive partition assignment within a
+    consumer group: partition ``p`` belongs to member ``sorted(members)
+    [p % len(members)]``. Every member computes the same answer from the
+    same (generation-fenced) membership list, so a rebalance needs no
+    assignment negotiation — only agreement on WHO is in the group,
+    which the coordinator provides."""
+    ordered = sorted(members)
+    if member_id not in ordered:
+        return ()
+    i = ordered.index(member_id)
+    return tuple(p for p in range(n_partitions) if p % len(ordered) == i)

@@ -1,0 +1,104 @@
+"""Cluster telemetry (obs source ``cluster``): the numbers an operator
+needs when "a server" became "a service" — which partition map version
+the client is on, how often membership churned, how much moved, and
+whether cross-server EOS aggregation actually converged.
+
+One process-wide instance (:data:`CLUSTER`), registered in the default
+MetricsRegistry on first cluster use — the same self-registration
+pattern as the ``stream`` and ``evloop`` sources."""
+
+from __future__ import annotations
+
+import threading
+
+
+class ClusterTelemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registered = False  # guarded-by: _lock
+        self.map_version = 0  # guarded-by: _lock
+        self.servers_live = 0  # guarded-by: _lock
+        self.servers_dead = 0  # guarded-by: _lock
+        self.partitions = 0  # guarded-by: _lock
+        self.reassignments = 0  # partition moves after a server death  # guarded-by: _lock
+        self.rebalances = 0  # group assignment changes applied  # guarded-by: _lock
+        self.generation = 0  # last observed group generation  # guarded-by: _lock
+        self.fenced = 0  # coordinator rejections of stale-generation ops  # guarded-by: _lock
+        self.retained_resent = 0  # acked-but-possibly-lost frames resent  # guarded-by: _lock
+        self.tail_resent = 0  # unacked windowed-put tail frames resent  # guarded-by: _lock
+        self.partitions_drained = 0  # guarded-by: _lock
+        self.eos_aggregated = 0  # synthesized end-of-stream markers emitted  # guarded-by: _lock
+        self.depth_by_server: dict = {}  # last probed depth per server  # guarded-by: _lock
+
+    def ensure_registered(self):
+        with self._lock:
+            if self._registered:
+                return
+            self._registered = True
+        try:
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().register("cluster", self)
+        except Exception:  # obs optional: the cluster must work without it
+            pass
+
+    def map_changed(self, version: int, live: int, dead: int, partitions: int,
+                    moved: int = 0):
+        self.ensure_registered()
+        with self._lock:
+            self.map_version = version
+            self.servers_live = live
+            self.servers_dead = dead
+            self.partitions = partitions
+            self.reassignments += moved
+
+    def rebalanced(self, generation: int):
+        with self._lock:
+            self.rebalances += 1
+            self.generation = generation
+
+    def fenced_op(self):
+        with self._lock:
+            self.fenced += 1
+
+    def resent(self, retained: int, tail: int):
+        with self._lock:
+            self.retained_resent += retained
+            self.tail_resent += tail
+
+    def drained(self):
+        with self._lock:
+            self.partitions_drained += 1
+
+    def eos_emitted(self):
+        with self._lock:
+            self.eos_aggregated += 1
+
+    def observe_depths(self, depths: dict):
+        with self._lock:
+            self.depth_by_server = dict(depths)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "map_version": self.map_version,
+                "servers_live": self.servers_live,
+                "servers_dead": self.servers_dead,
+                "partitions": self.partitions,
+                "reassignments_total": self.reassignments,
+                "rebalances_total": self.rebalances,
+                "generation": self.generation,
+                "fenced_total": self.fenced,
+                "retained_resent_total": self.retained_resent,
+                "tail_resent_total": self.tail_resent,
+                "partitions_drained_total": self.partitions_drained,
+                "eos_aggregated_total": self.eos_aggregated,
+                "depth_by_server": dict(self.depth_by_server),
+            }
+
+    # obs registry source protocol
+    def snapshot(self) -> dict:
+        return self.stats()
+
+
+CLUSTER = ClusterTelemetry()

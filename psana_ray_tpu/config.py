@@ -94,6 +94,15 @@ class TransportConfig:
     # producer.py:101, survives only on in-process/shm paths where a put
     # is a memcpy, not a round trip)
     put_batch_size: int = 16
+    # sharded queue cluster (cluster:// addresses, psana_ray_tpu.cluster):
+    # how many partitions the logical queue shards into (placement is
+    # rendezvous-hashed over the live server set; fixed for the life of
+    # a stream — every producer and consumer must agree on it)
+    cluster_partitions: int = 8
+    # consumer-group name ("" = no group: every consumer competes on all
+    # partitions) and this member's stable id ("" = random per process)
+    group: str = ""
+    member_id: str = ""
 
 
 @dataclasses.dataclass

@@ -243,9 +243,11 @@ def main(argv=None):
         "summary; 0 = off",
     )
     from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.transport.addressing import add_cluster_args
 
     add_metrics_args(p)
     add_trace_args(p)
+    add_cluster_args(p, consumer=True)
     p.add_argument(
         "--cursor_path", default=None,
         help="persist a StreamCursor (contiguous per-shard watermark of "
@@ -273,6 +275,12 @@ def main(argv=None):
         format="%(asctime)s - %(levelname)s - %(message)s",
     )
     log = logging.getLogger("consumer")
+    from psana_ray_tpu.transport.addressing import apply_cluster_args
+
+    # --cluster rewrites the address (and carries partitions/group): the
+    # DataReader below sees the sharded service as just another address
+    reader_config = apply_cluster_args(TransportConfig(address=a.address), a)
+    a.address = reader_config.address
 
     stop = False
 
@@ -342,6 +350,7 @@ def main(argv=None):
     try:
         with trace(a.profile_dir), DataReader(
             address=a.address, queue_name=a.queue_name, namespace=a.namespace,
+            config=reader_config,
             streaming=a.stream, stream_window=a.stream_window,
         ) as reader:
             if observe_dwell or a.trace_dir:

@@ -247,7 +247,12 @@ def batches_from_queue(
     per-pop round trip and the empty-queue poll both disappear and
     ``poll_interval_s`` only paces this loop's stop/stall checks
     (``prefer_stream=False`` forces the request/response pull, e.g. for
-    A/B benchmarking).
+    A/B benchmarking). A sharded cluster queue (:class:`psana_ray_tpu.
+    cluster.client.ClusterClient`) presents the same entry point: its
+    ``get_batch_stream`` fans in over every assigned partition's credit
+    stream and already aggregates per-partition EOS markers into ONE
+    end-of-stream, so this loop's tally sees a cluster exactly like a
+    single queue.
     ``max_wait_s`` bounds total starvation (None = wait forever, matching
     the reference consumer loop); with ``raise_on_stall=True`` hitting it
     raises :class:`StreamStalled` (after yielding any pending tail) instead

@@ -43,12 +43,6 @@ ALLOWLIST = (
         "lengths); frame payloads go through _recv_into on a pooled lease",
     ),
     Allow(
-        "hot-alloc", "transport/tcp.py", "conn.recv(1, socket.MSG_PEEK)",
-        why="_peer_hung_up: 1-byte non-blocking MSG_PEEK liveness probe "
-        "(dead-client detection during a blocking windowed-put enqueue); "
-        "nothing frame-sized is materialized and the byte stays queued",
-    ),
-    Allow(
         "hot-alloc", "transport/codec.py", "return [TAG_RECORD + item.to_bytes()]",
         why="EndOfStream wire form is header-only (tens of bytes), not a frame",
     ),

@@ -53,7 +53,13 @@ ROOTS = {
     "FanInPipeline.close",
 }
 
-# bare-name edges the getattr() transport-preference indirection hides
+# bare-name edges the getattr() transport-preference indirection hides.
+# NOTE: because edges resolve by BARE callee name, the get_batch_stream
+# seed reaches every indexed implementation — TcpStreamReader AND the
+# cluster client's partition-merge drain (ClusterClient.get_batch_stream
+# -> _merge_drain -> _pop/_sift, ISSUE 7), which is exactly the audited
+# surface we want: a sleep pacing the partition sweep stalls the whole
+# infeed. Pinned by test_lint's cluster_merge_drain fixture pair.
 SEED_EDGES = {
     "batches_from_queue": ("get_batch", "get_batch_view", "get_batch_stream")
 }

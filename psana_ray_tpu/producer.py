@@ -389,9 +389,11 @@ def parse_arguments(argv=None):
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--log_level", default="INFO")
     from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.transport.addressing import add_cluster_args
 
     add_metrics_args(p)
     add_trace_args(p)
+    add_cluster_args(p)
     p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
     p.add_argument("--num_events", type=int, default=1024, help="synthetic events")
     p.add_argument(
@@ -414,6 +416,8 @@ def parse_arguments(argv=None):
         "contiguous processed watermark (at-least-once)",
     )
     a = p.parse_args(argv)
+    from psana_ray_tpu.transport.addressing import apply_cluster_args
+
     return PipelineConfig(
         source=SourceConfig(
             exp=a.exp,
@@ -428,12 +432,15 @@ def parse_arguments(argv=None):
             cursor_path=a.cursor_path,
         ),
         mask=MaskConfig(a.uses_bad_pixel_mask, a.manual_mask_path),
-        transport=TransportConfig(
-            address=a.address,
-            namespace=a.namespace,
-            queue_name=a.queue_name,
-            queue_size=a.queue_size,
-            num_consumers=a.num_consumers,
+        transport=apply_cluster_args(
+            TransportConfig(
+                address=a.address,
+                namespace=a.namespace,
+                queue_name=a.queue_name,
+                queue_size=a.queue_size,
+                num_consumers=a.num_consumers,
+            ),
+            a,
         ),
     ), a
 
@@ -501,7 +508,9 @@ def main(argv=None):
     MetricsRegistry.default().register("producer", runtime.metrics)
     metrics_server = start_metrics_server(args.metrics_port, host=args.metrics_host)
     monitor = None
-    if metrics_server is not None and str(config.transport.address).startswith("tcp://"):
+    if metrics_server is not None and str(config.transport.address).startswith(
+        ("tcp://", "cluster://")
+    ):
         # depth for scrapes over a DEDICATED connection: on the data
         # connection a stats() probe would queue behind a put's reconnect
         # backoff under the client lock, hanging /metrics for the whole

@@ -46,19 +46,6 @@ def main(argv=None):
         ),
     )
     p.add_argument(
-        "--server_mode",
-        choices=("evloop", "threads"),
-        default=None,
-        help=(
-            "connection-serving architecture: 'evloop' (default) runs ONE "
-            "epoll readiness loop with per-connection state machines — "
-            "thread count independent of connection count, scales to "
-            "thousands of streamed subscribers; 'threads' is the legacy "
-            "thread-per-connection path, retained for one release "
-            "(PSANA_TCP_SERVER_MODE overrides the default)"
-        ),
-    )
-    p.add_argument(
         "--max_conns",
         type=int,
         default=0,
@@ -135,13 +122,15 @@ def main(argv=None):
 
     server = TcpQueueServer(
         backing, host=a.host, port=a.port, maxsize=a.queue_size,
-        queue_factory=queue_factory, mode=a.server_mode,
-        max_conns=a.max_conns,
+        queue_factory=queue_factory, max_conns=a.max_conns,
     ).serve_background()
     logger.info(
-        "queue server listening on %s:%d (size=%d, mode=%s%s) — clients "
-        "use --address tcp://<host>:%d",
-        a.host, server.port, a.queue_size, server.mode,
+        "queue server listening on %s:%d (size=%d%s) — clients use "
+        "--address tcp://<host>:%d, or start N of these and point "
+        "clients at --cluster host:port,host:port (sharded queue "
+        "service; the legacy thread-per-connection --server_mode was "
+        "removed, the epoll event loop is THE server)",
+        a.host, server.port, a.queue_size,
         f", max_conns={a.max_conns}" if a.max_conns else "",
         server.port,
     )

@@ -373,6 +373,19 @@ class EosTally:
         self._pending_dups = kept
         return placed
 
+    def markers(self) -> list:
+        """Reconstruct one EOS marker per observed producer rank — what a
+        consumer must RETURN to a queue it is handing off mid-tally (a
+        cluster rebalance revoking a partly-drained partition): the new
+        owner's tally re-observes the same coverage. Reconstruction, not
+        retention, so held duplicates stay with flush_duplicates."""
+        return [
+            EndOfStream(
+                producer_rank=rank, shards_done=done, total_shards=self._total
+            )
+            for rank, done in sorted(self._shards_by_rank.items())
+        ]
+
     @property
     def complete(self) -> bool:
         return sum(self._shards_by_rank.values()) >= self._total

@@ -435,6 +435,9 @@ def main(argv=None):
         "(resumed runs — cursor already has positions — always append)",
     )
     from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.transport.addressing import add_cluster_args
+
+    add_cluster_args(ap, consumer=True)
 
     add_metrics_args(ap)
     add_trace_args(ap)
@@ -516,10 +519,16 @@ def main(argv=None):
     stop_ev = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
 
-    cfg = dc.replace(
-        TransportConfig(), address=a.address, queue_name=a.queue_name,
-        namespace=a.namespace,
+    from psana_ray_tpu.transport.addressing import apply_cluster_args
+
+    cfg = apply_cluster_args(
+        dc.replace(
+            TransportConfig(), address=a.address, queue_name=a.queue_name,
+            namespace=a.namespace,
+        ),
+        a,
     )
+    a.address = cfg.address  # --cluster rewrote it (monitor shares it)
     try:
         queue = open_queue(cfg, role="consumer", address=a.address)
     except Exception as e:
@@ -565,7 +574,8 @@ def main(argv=None):
 
         try:
             monitor = DataReader(
-                address=a.address, queue_name=a.queue_name, namespace=a.namespace
+                address=a.address, queue_name=a.queue_name,
+                namespace=a.namespace, config=cfg,
             ).open_monitor()
         except Exception as e:  # noqa: BLE001 — depth is optional
             log.debug("queue monitor unavailable: %s", e)

@@ -19,6 +19,14 @@ queue within it:
   selects a *named queue on that server* (OPEN opcode): one server per
   cluster hosts every detector's queue, exactly like Ray's GCS hosts many
   named actors.
+- ``cluster://host:port,host:port,...`` — a SHARDED queue service over N
+  queue servers (:mod:`psana_ray_tpu.cluster`): the logical queue splits
+  into ``config.cluster_partitions`` partitions placed by rendezvous
+  hashing over the server list; the returned :class:`~psana_ray_tpu.
+  cluster.client.ClusterClient` speaks the same transport contract, so
+  everything downstream is unchanged. ``config.group`` enrolls a
+  consumer in a named consumer group (disjoint partition assignment,
+  rebalance on membership change, one aggregated EOS per group).
 
 Producers open with ``role='producer'`` (get-or-create semantics, parity
 ``producer.py:42-48``); consumers with ``role='consumer'`` (resolve with
@@ -39,6 +47,53 @@ def shm_ring_name(config: TransportConfig, address: Optional[str] = None) -> str
     address = address or config.address
     explicit = address[len("shm://"):] if address.startswith("shm://") else ""
     return explicit or f"{config.namespace}__{config.queue_name}"
+
+
+def add_cluster_args(parser, consumer: bool = False) -> None:
+    """The shared ``--cluster`` CLI surface (producer / consumer / sfx):
+    pointing a CLI at a sharded queue service is an address-list change,
+    nothing else."""
+    parser.add_argument(
+        "--cluster", default=None, metavar="HOST:PORT,HOST:PORT",
+        help="queue-server cluster: shard the logical queue over these "
+        "servers (overrides --address with cluster://...). The FIRST "
+        "server doubles as the consumer-group coordinator. Every "
+        "producer and consumer of one stream must pass the same list "
+        "and --partitions",
+    )
+    parser.add_argument(
+        "--partitions", type=int, default=8,
+        help="partitions the logical queue shards into across the "
+        "cluster (fixed for the life of a stream)",
+    )
+    if consumer:
+        parser.add_argument(
+            "--group", default="",
+            help="consumer-group name: members share the stream with "
+            "disjoint partition assignments, rebalancing on "
+            "join/leave/death; empty = compete on all partitions",
+        )
+        parser.add_argument(
+            "--member_id", default="",
+            help="stable member id within --group (default: random per "
+            "process — fine unless you want sticky assignment)",
+        )
+
+
+def apply_cluster_args(config: TransportConfig, args) -> TransportConfig:
+    """Fold the ``--cluster`` flags into a TransportConfig (no-op when
+    the flag is absent)."""
+    import dataclasses
+
+    if not getattr(args, "cluster", None):
+        return config
+    return dataclasses.replace(
+        config,
+        address=f"cluster://{args.cluster}",
+        cluster_partitions=args.partitions,
+        group=getattr(args, "group", "") or "",
+        member_id=getattr(args, "member_id", "") or "",
+    )
 
 
 def open_queue(
@@ -98,6 +153,23 @@ def open_queue(
                 interval_s=config.rendezvous_interval_s,
             )
 
+    if address.startswith("cluster://"):
+        from psana_ray_tpu.cluster.client import ClusterClient
+
+        # producers never join consumer groups — a group is a consumer-
+        # side partition-ownership construct; a producer in the member
+        # list would hold (and starve) partitions it never reads
+        group = config.group if role == "consumer" else ""
+        return ClusterClient(
+            address,
+            namespace=config.namespace,
+            queue_name=config.queue_name,
+            n_partitions=config.cluster_partitions,
+            maxsize=config.queue_size,
+            group=group or None,
+            member_id=config.member_id or None,
+        )
+
     if address.startswith("tcp://"):
         from psana_ray_tpu.transport.tcp import TcpQueueClient
 
@@ -116,5 +188,6 @@ def open_queue(
         )
 
     raise ValueError(
-        f"unknown address scheme {address!r} (want auto | shm://[name] | tcp://host:port)"
+        f"unknown address scheme {address!r} (want auto | shm://[name] | "
+        f"tcp://host:port | cluster://host:port,host:port,...)"
     )

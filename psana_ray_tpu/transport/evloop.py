@@ -2,12 +2,13 @@
 """Event-loop TCP queue server: one epoll loop, thousands of streamed
 consumers (ISSUE 6).
 
-The thread-per-connection server in :mod:`transport.tcp` is fine at tens
-of consumers and dead at thousands: a thread stack (plus an ack-reader
-thread per streamed subscriber), GIL contention across serve threads,
-and lock convoys on the shared queue maps. PR 5's server-push streaming
-already removed the request/response coupling, so the relay is shaped
-like an event loop — this module is that loop.
+The thread-per-connection server (removed in ISSUE 7 after one release
+behind ``mode="threads"``) was fine at tens of consumers and dead at
+thousands: a thread stack (plus an ack-reader thread per streamed
+subscriber), GIL contention across serve threads, and lock convoys on
+the shared queue maps. PR 5's server-push streaming already removed the
+request/response coupling, so the relay is shaped like an event loop —
+this module is that loop, and since ISSUE 7 it is THE server.
 
 Design:
 
@@ -16,10 +17,10 @@ Design:
   non-blocking scatter-gather writes with EPOLLOUT-driven partial-send
   resumption. Thread count is independent of connection count; memory
   is O(connections x small struct).
-- Each connection is a :class:`_EvConn` state machine over the SAME 16
-  opcodes and wire bytes as the threaded server (the opcode constants
-  and part-gathering helpers are imported from ``transport.tcp``, so
-  the wire format cannot fork). Reads land incrementally: control
+- Each connection is a :class:`_EvConn` state machine over all 17
+  opcodes of the wire protocol (the opcode constants and
+  part-gathering helpers are imported from ``transport.tcp``, so the
+  wire format cannot fork). Reads land incrementally: control
   fields into a per-connection reused scratch buffer, payloads straight
   into pooled ``recv_into`` leases (the zero-copy datapath of ISSUE 2
   is unchanged — a PUT's pooled buffer is the very memory a later
@@ -79,6 +80,7 @@ from psana_ray_tpu.transport.tcp import (
     _OP_ANCHOR,
     _OP_BYE,
     _OP_CLOSE,
+    _OP_CLUSTER,
     _OP_GET,
     _OP_GET_BATCH,
     _OP_GET_BATCH_WAIT,
@@ -793,6 +795,27 @@ class _EvConn:
         # already cleared when this opcode arrived)
         self._begin_close()
 
+    def _op_cluster(self) -> None:
+        self._expect(4, self._cluster_len)
+
+    def _cluster_len(self) -> None:
+        (n,) = struct.unpack_from("<I", self._hdr)
+        if n > 1 << 20:  # control-plane JSON: a MB is already absurd
+            raise ConnectionError(f"cluster RPC payload {n} bytes")
+        # dedicated exact-size buffer: group RPCs are rare control plane
+        self._open_buf = bytearray(n)
+        self._arm(memoryview(self._open_buf), self._cluster_finish)
+
+    def _cluster_finish(self) -> None:
+        try:
+            req = json.loads(self._open_buf.decode())
+            resp = self.srv.groups.handle(req)
+        except Exception as e:  # noqa: BLE001 — a bad RPC must not kill the loop
+            resp = {"ok": False, "error": repr(e)}
+        payload = json.dumps(resp).encode()
+        self.send_parts([_ST_OK + struct.pack("<I", len(payload)), payload])
+        self._await_op()
+
     def _op_open(self) -> None:
         self._expect(2, self._open_ns_len)
 
@@ -839,6 +862,7 @@ _OPS: Dict[int, str] = {
     _OP_OPEN[0]: "_op_open",
     _OP_STATS[0]: "_op_stats",
     _OP_ANCHOR[0]: "_op_anchor",
+    _OP_CLUSTER[0]: "_op_cluster",
     _OP_BYE[0]: "_op_bye",
 }
 

@@ -39,6 +39,11 @@ Wire protocol (all little-endian):
                   the exchange so the trace merge tool (obs.trace_merge)
                   can align this host's clock to the server's, bounded
                   by the measured RTT
+              'N' (cluster/group RPC) + len:u32 + JSON — consumer-group
+                  coordination (join/heartbeat/leave/drained/info against
+                  the server's :class:`psana_ray_tpu.cluster.coordinator.
+                  GroupRegistry`); by convention clients send it to the
+                  FIRST server of the cluster address list
               'F' (bye) — no response; acks the last delivery and ends
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
@@ -48,6 +53,7 @@ Wire protocol (all little-endian):
               + [W ok] seq:u64 (the acknowledged put's sequence number)
               + [T ok] len:u32 + JSON stats object
               + [A ok] wall:f64 + mono:f64
+              + [N ok] len:u32 + JSON group-state object
     stream push (server -> client, after 'M'):
               status:u8 ('1') + seq:u64 + len:u32 + payload per frame;
               'X' when the bound queue closes (the stream is over)
@@ -136,16 +142,24 @@ In-flight items are never dropped on a consumer crash: if the connection
 dies between the queue pop and the response write, the server re-enqueues
 the popped item(s).
 
-Server architecture (ISSUE 6): the default server is a single
-selectors/epoll readiness loop (:mod:`psana_ray_tpu.transport.evloop`)
-driving a per-connection state machine over all 16 opcodes — memory
-O(connections x small struct), thread count independent of connection
-count, blocking waits ('W'/'U'/'D', stream credit stalls) held as
-timer/deferred-callback state instead of parked threads. The
-thread-per-connection implementation in this module remains available
-behind ``mode="threads"`` for one release. Both modes produce
-byte-identical wire traffic (pinned by test_wire_zero_copy and the
-wire-opcode checker) and share the delivery contract above.
+Server architecture (ISSUE 6): the server IS a single selectors/epoll
+readiness loop (:mod:`psana_ray_tpu.transport.evloop`) driving a
+per-connection state machine over all 17 opcodes — memory O(connections
+x small struct), thread count independent of connection count, blocking
+waits ('W'/'U'/'D', stream credit stalls) held as timer/deferred-
+callback state instead of parked threads. The legacy thread-per-
+connection implementation was retained one release behind
+``mode="threads"`` and has been REMOVED (ISSUE 7); the wire bytes and
+delivery contract are pinned by test_wire_zero_copy / test_tcp /
+test_tcp_stream and the wire-opcode checker. This module keeps the
+protocol definition (opcode constants, framing helpers) and the client.
+
+Cluster (ISSUE 7): N servers become one logical queue service through
+:mod:`psana_ray_tpu.cluster` — a logical queue shards into partitions,
+each an ordinary named queue here (``<queue>#p<N>`` via OPEN), placed by
+rendezvous hashing over the live server set; :class:`psana_ray_tpu.
+cluster.client.ClusterClient` wraps one TcpQueueClient per partition
+and presents this module's transport contract unchanged.
 """
 
 from __future__ import annotations
@@ -186,20 +200,18 @@ _OP_STREAM_ACK = b"K"
 _OP_OPEN = b"O"
 _OP_STATS = b"T"
 _OP_ANCHOR = b"A"
+_OP_CLUSTER = b"N"
 _OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
 _ST_ERR = b"E"
 
-# The longest one bounded-wait request ('D'/'U' timeout field, one
-# windowed-put enqueue attempt, one stream pop) may hold a serve thread:
-# long enough that an idle consumer costs ~one round trip per interval,
-# short enough that drain/shutdown and connection-death detection stay
-# timely.
+# The longest one bounded-wait request ('D'/'U' timeout field) may defer
+# server-side: long enough that an idle consumer costs ~one round trip
+# per interval, short enough that drain/shutdown and connection-death
+# detection stay timely.
 _SERVER_WAIT_CAP_S = 2.0
-# stream push loop: queue-pop granularity while credits are available
-_STREAM_POP_TIMEOUT_S = 0.25
 # default credit window (frames in flight) for stream subscriptions and
 # the windowed-put pipeline — bounds client memory like a prefetch depth
 DEFAULT_STREAM_WINDOW = 32
@@ -435,31 +447,6 @@ def _recv_payload(sock: socket.socket, n: int, pool: BufferPool):
         raise
 
 
-def _peer_hung_up(conn: socket.socket) -> bool:
-    """Non-destructive liveness probe for a connection we are NOT
-    currently reading: True when the peer closed (orderly FIN) or reset.
-    Bytes waiting (a pipelined client's next request) mean alive — they
-    are left in place (MSG_PEEK). Used by server-side blocking enqueues
-    so backpressure never pins a serve thread to a dead client."""
-    try:
-        conn.setblocking(False)
-        try:
-            return conn.recv(1, socket.MSG_PEEK) == b""
-        except (BlockingIOError, InterruptedError):
-            return False  # nothing to read: peer alive, just quiet
-        finally:
-            conn.setblocking(True)
-    except OSError:
-        return True
-
-
-def _send_response_payload(conn: socket.socket, item) -> None:
-    """One ``status + len + payload`` response, scatter-gather."""
-    parts = _encode_parts(item)
-    head = _ST_OK + struct.pack("<I", _parts_nbytes(parts))
-    _sendmsg_all(conn, [head, *parts])
-
-
 # -- relay-side tracing (sampled frames only; gated on TRACER.enabled) ----
 def _stamp_relay_arrival(item) -> None:
     """Mark a sampled frame's arrival at the relay (server PUT decode) —
@@ -488,16 +475,15 @@ def _emit_relay_spans(items, t_send0: float) -> None:
         TRACER.span(trace.trace_id, SPAN_RELAY, t_send0, t_done)
 
 
-# -- server modes ----------------------------------------------------------
-# "evloop" (default): ONE selectors/epoll readiness loop serves every
-# connection through per-connection state machines — O(connections x
-# small struct) memory, thread count independent of connection count
-# (ISSUE 6; implementation in transport/evloop.py). "threads": the
-# legacy thread-per-connection server retained behind this flag for one
-# release (a thread + an ack-reader thread per streamed subscriber —
-# fine at tens of consumers, dead at thousands).
+# -- server mode -----------------------------------------------------------
+# "evloop" is THE server: ONE selectors/epoll readiness loop serves
+# every connection through per-connection state machines — O(connections
+# x small struct) memory, thread count independent of connection count
+# (ISSUE 6; implementation in transport/evloop.py). The legacy
+# thread-per-connection mode ("threads") was retained one release behind
+# this knob and removed in ISSUE 7.
 DEFAULT_SERVER_MODE = "evloop"
-_SERVER_MODES = ("evloop", "threads")
+_SERVER_MODES = ("evloop",)
 
 
 def _resolve_server_mode(mode: Optional[str]) -> str:
@@ -506,7 +492,9 @@ def _resolve_server_mode(mode: Optional[str]) -> str:
     m = mode or os.environ.get("PSANA_TCP_SERVER_MODE") or DEFAULT_SERVER_MODE
     if m not in _SERVER_MODES:
         raise ValueError(
-            f"unknown server mode {m!r}; expected one of {_SERVER_MODES}"
+            f"unknown server mode {m!r}; expected one of {_SERVER_MODES} "
+            f"(the legacy thread-per-connection mode was removed one "
+            f"release after the event-loop server became the default)"
         )
     return m
 
@@ -532,19 +520,19 @@ class TcpQueueServer:
     queues that clients OPEN by (namespace, queue_name) — see the module
     docstring. Start with ``serve_background()``.
 
-    Two serve modes (``mode=``, default :data:`DEFAULT_SERVER_MODE`,
-    overridable via ``PSANA_TCP_SERVER_MODE``):
+    The serving architecture is one epoll readiness loop with
+    per-connection state machines for all 17 opcodes, blocking waits as
+    timer/deferred state (:mod:`psana_ray_tpu.transport.evloop`) —
+    scales to thousands of streamed subscribers with O(1) threads. The
+    legacy thread-per-connection mode was removed (ISSUE 7); ``mode``
+    remains as a guard that rejects anything but ``"evloop"``.
 
-    - ``"evloop"`` — one epoll readiness loop, per-connection state
-      machines for all 16 opcodes, blocking waits as timer/deferred
-      state (:mod:`psana_ray_tpu.transport.evloop`). Scales to
-      thousands of streamed subscribers with O(1) threads.
-    - ``"threads"`` — the legacy thread-per-connection path, kept for
-      one release behind this flag.
-
-    Both speak the identical wire protocol and delivery contract;
     ``max_conns`` (0 = unlimited) refuses connections past the limit
-    with a clean ``_ST_ERR`` instead of accepting unboundedly."""
+    with a clean ``_ST_ERR`` instead of accepting unboundedly. The
+    server also hosts the cluster consumer-group coordinator state
+    (``groups`` — :class:`psana_ray_tpu.cluster.coordinator.
+    GroupRegistry`) behind the 'N' RPC; it is inert unless a cluster
+    client elects this server as its coordinator."""
 
     def __init__(
         self,
@@ -584,7 +572,12 @@ class TcpQueueServer:
         self._conns_lock = threading.Lock()
         self.mode = _resolve_server_mode(mode)
         self.max_conns = int(max_conns)
-        self._loop = None  # evloop mode: the EventLoop driving this server
+        self._loop = None  # the EventLoop driving this server
+        # consumer-group coordinator state (cluster 'N' RPC). Imported
+        # lazily: psana_ray_tpu.cluster's client half imports this module
+        from psana_ray_tpu.cluster.coordinator import GroupRegistry
+
+        self.groups = GroupRegistry()
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
         """Get-or-create the named queue (the OPEN opcode server-side;
@@ -668,54 +661,16 @@ class TcpQueueServer:
                 pass
 
     def serve_background(self) -> "TcpQueueServer":
-        if self.mode == "evloop":
-            from psana_ray_tpu.transport.evloop import EventLoop
+        from psana_ray_tpu.transport.evloop import EventLoop
 
-            self._loop = EventLoop(self)
-            t = threading.Thread(
-                target=self._loop.run, daemon=True, name="tcp-evloop"
-            )
-        else:
-            t = threading.Thread(
-                target=self._accept_loop, daemon=True, name="tcp-queue-accept"
-            )
+        self._loop = EventLoop(self)
+        t = threading.Thread(
+            target=self._loop.run, daemon=True, name="tcp-evloop"
+        )
         t.start()
         self._accept_thread = t
         self._threads.append(t)
         return self
-
-    def _accept_loop(self):
-        # legacy-path fix retained with the thread-per-connection mode:
-        # the 0.2 s accept timeout is the poll that lets this loop
-        # observe _stop (the evloop mode replaces it with readiness-
-        # driven accept + an explicit waker)
-        try:
-            self._sock.settimeout(0.2)
-        except OSError:  # shutdown() closed the socket before we got here
-            return
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            # prune finished connection threads — the server is a
-            # long-lived service (queue_server.py) and must not grow
-            # unboundedly across client reconnects
-            self._threads = [t for t in self._threads if t.is_alive()]
-            with self._conns_lock:
-                self._conns = [c for c in self._conns if c.fileno() != -1]
-                self._conns.append(conn)
-                n_active = len(self._conns)
-            if self.max_conns and n_active > self.max_conns:
-                with self._conns_lock:
-                    self._conns.remove(conn)
-                _refuse_conn(conn, self.port, n_active - 1, self.max_conns)
-                continue
-            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
-            t.start()
-            self._threads.append(t)
 
     def _requeue(self, queue, items):
         """Put back items popped but never delivered (the client connection
@@ -728,293 +683,6 @@ class TcpQueueServer:
         if items:
             FLIGHT.record("requeue_in_flight", count=len(items))
         return_to_queue(queue, items, what="in-flight frame")
-
-    def _send_batch_response(self, conn: socket.socket, items) -> List[Any]:
-        """One ``status + count + count x (len + payload)`` response
-        ('B'/'D'), scatter-gather; returns the delivered items (the
-        caller's in-flight set) after emitting relay spans."""
-        in_flight = list(items)
-        parts = [_ST_OK, struct.pack("<I", len(in_flight))]
-        for item in in_flight:
-            item_parts = _encode_parts(item)
-            parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
-            parts.extend(item_parts)
-        t_send0 = time.monotonic() if TRACER.enabled else 0.0
-        _sendmsg_all(conn, parts)
-        if TRACER.enabled:
-            _emit_relay_spans(in_flight, t_send0)
-        return in_flight
-
-    def _serve_stream(self, conn: socket.socket, queue, window: int):
-        """Server half of stream mode (opcode 'M'): push queued frames as
-        they arrive, at most ``window`` unacknowledged; a reader thread
-        consumes the client's cumulative 'K' acks (credit replenish) and
-        'F' (clean unsubscribe). Pushed-but-unacked frames are re-enqueued
-        at the queue head when the connection ends — the streaming
-        equivalent of the request/response in-flight requeue, so crash
-        redelivery stays at-least-once (duplicates possible, loss never)."""
-        window = max(1, min(int(window), 4096))
-        STREAM.opened(window)
-        FLIGHT.record("stream_open", port=self.port, window=window)
-        cond = threading.Condition()
-        state = {"acked": 0, "bye": False, "dead": False}
-
-        def _read_acks():
-            try:
-                while True:
-                    op = _recv_exact(conn, 1)
-                    if op == _OP_STREAM_ACK:
-                        (seq,) = struct.unpack("<Q", _recv_exact(conn, 8))
-                        with cond:
-                            if seq > state["acked"]:
-                                state["acked"] = seq
-                                STREAM.acked_msg()
-                            cond.notify()
-                    elif op == _OP_BYE:
-                        with cond:
-                            state["bye"] = True
-                            cond.notify()
-                        return
-                    else:
-                        raise ConnectionError(
-                            f"bad opcode {op!r} on streamed connection"
-                        )
-            except (ConnectionError, OSError):
-                with cond:
-                    state["dead"] = True
-                    cond.notify()
-
-        reader = threading.Thread(
-            target=_read_acks, daemon=True, name="tcp-stream-acks"
-        )
-        reader.start()
-        seq = 0
-        unacked: deque = deque()  # (seq, item) in push order — redelivery tail
-        queue_closed = False
-        try:
-            while not self._stop.is_set():
-                with cond:
-                    while unacked and unacked[0][0] <= state["acked"]:
-                        unacked.popleft()  # credit returned: lease may free
-                        STREAM.pruned(1)
-                    if state["bye"] or state["dead"]:
-                        break
-                    budget = window - (seq - state["acked"])
-                    if budget <= 0:  # window full: wait for credits
-                        cond.wait(timeout=0.2)
-                        continue
-                try:
-                    items = queue.get_batch(
-                        min(budget, 64), timeout=_STREAM_POP_TIMEOUT_S
-                    )
-                except TransportClosed:
-                    queue_closed = True
-                    try:
-                        conn.sendall(_ST_CLOSED)  # the stream is over
-                    except OSError:
-                        pass
-                    break
-                if not items:
-                    continue
-                t_send0 = time.monotonic() if TRACER.enabled else 0.0
-                parts = []
-                for item in items:
-                    seq += 1
-                    unacked.append((seq, item))
-                    item_parts = _encode_parts(item)
-                    parts.append(
-                        _ST_OK
-                        + struct.pack("<QI", seq, _parts_nbytes(item_parts))
-                    )
-                    parts.extend(item_parts)
-                _sendmsg_all(conn, parts)
-                STREAM.pushed(len(items))
-                if TRACER.enabled:
-                    _emit_relay_spans(items, t_send0)
-        except (ConnectionError, OSError):
-            pass  # redelivery below
-        finally:
-            with cond:
-                while unacked and unacked[0][0] <= state["acked"]:
-                    unacked.popleft()
-                    STREAM.pruned(1)
-                clean = state["bye"]
-                lost = [item for (_s, item) in unacked]
-            if lost:
-                STREAM.pruned(len(lost))
-                if not queue_closed:
-                    STREAM.redelivered_n(len(lost))
-                    FLIGHT.record(
-                        "stream_redelivery", count=len(lost), clean_bye=clean
-                    )
-                    self._requeue(queue, lost)
-            STREAM.closed(window)
-            reader.join(timeout=2.0)
-
-    def _serve_conn(self, conn: socket.socket):
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        queue = self.queue  # rebound by OPEN; default-queue back-compat
-        # Items popped whose DELIVERY is unconfirmed. sendall() returning
-        # only proves the bytes reached the kernel buffer — the link can
-        # still die with the response undelivered, and the client's
-        # reconnect-retry would then silently skip those frames. So the
-        # implicit ACK is the client's NEXT request (it only sends one
-        # after fully reading the previous response): in_flight clears at
-        # the next opcode, and a connection that dies first re-enqueues.
-        # Clean disconnects ACK explicitly with BYE; crashed clients may
-        # therefore cause duplicates (at-least-once), never silent loss.
-        in_flight: List[Any] = []
-        try:
-            while not self._stop.is_set():
-                op = _recv_exact(conn, 1)
-                in_flight = []  # previous response fully read (see above)
-                try:
-                    if op == _OP_PUT:
-                        (n,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        # read BEFORE any refusal: no desync. The payload
-                        # lands in a pooled lease; frames decode zero-copy
-                        # and ride the queue still viewing that buffer
-                        item = _recv_payload(conn, n, self._pool)
-                        if TRACER.enabled:
-                            _stamp_relay_arrival(item)
-                        if self._draining:
-                            conn.sendall(_ST_CLOSED)
-                            continue
-                        ok = queue.put(item)
-                        conn.sendall(_ST_OK if ok else _ST_NO)
-                    elif op == _OP_GET:
-                        item = queue.get()
-                        if item is EMPTY:
-                            conn.sendall(_ST_NO)
-                        else:
-                            in_flight = [item]  # held until the next opcode
-                            t_send0 = time.monotonic() if TRACER.enabled else 0.0
-                            _send_response_payload(conn, item)
-                            if TRACER.enabled:
-                                _emit_relay_spans(in_flight, t_send0)
-                    elif op == _OP_GET_BATCH:
-                        (max_items,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        items = queue.get_batch(min(max_items, 4096), timeout=0.0)
-                        # held until the next opcode:
-                        in_flight = self._send_batch_response(conn, items)
-                    elif op == _OP_GET_BATCH_WAIT:
-                        # bounded server-side wait for the FIRST item: an
-                        # empty queue costs the client one round trip per
-                        # cap interval, not one per poll tick
-                        max_items, wait_ms = struct.unpack(
-                            "<II", _recv_exact(conn, 8)
-                        )
-                        items = queue.get_batch(
-                            min(max_items, 4096),
-                            timeout=min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S),
-                        )
-                        in_flight = self._send_batch_response(conn, items)
-                    elif op == _OP_PUT_WAIT:
-                        # bounded server-side wait for queue SPACE — the
-                        # producer-side mirror of 'D' (no 1 kHz retry
-                        # round trips against a full queue)
-                        wait_ms, n = struct.unpack("<II", _recv_exact(conn, 8))
-                        item = _recv_payload(conn, n, self._pool)
-                        if TRACER.enabled:
-                            _stamp_relay_arrival(item)
-                        if self._draining:
-                            conn.sendall(_ST_CLOSED)
-                            continue
-                        ok = queue.put_wait(
-                            item, timeout=min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S)
-                        )
-                        conn.sendall(_ST_OK if ok else _ST_NO)
-                    elif op == _OP_PUT_SEQ:
-                        # windowed pipelined put: enqueue (waiting for
-                        # space — backpressure reaches the client as a
-                        # delayed ack) and echo the sequence number. The
-                        # client reads acks lazily, up to W in flight.
-                        seq, n = struct.unpack("<QI", _recv_exact(conn, 12))
-                        item = _recv_payload(conn, n, self._pool)
-                        if TRACER.enabled:
-                            _stamp_relay_arrival(item)
-                        if self._draining:
-                            conn.sendall(_ST_CLOSED)
-                            continue
-                        accepted = False
-                        while not self._stop.is_set():
-                            if queue.put_wait(item, timeout=0.5):
-                                accepted = True
-                                break
-                            # the enqueue wait can outlive any timeout
-                            # (that IS the backpressure), so probe the
-                            # peer between slices: a dead client must
-                            # not pin this thread + the frame's lease
-                            # forever, and its frame must not enqueue
-                            # arbitrarily late on top of the reconnect
-                            # resend (the un-acked put redelivers there)
-                            if _peer_hung_up(conn):
-                                return
-                        if not accepted:
-                            return  # server stopping mid-window: client resends
-                        conn.sendall(_ST_OK + struct.pack("<Q", seq))
-                    elif op == _OP_STREAM:
-                        (window,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        self._serve_stream(conn, queue, window)
-                        return  # the stream consumed the connection
-                    elif op == _OP_PUT_BATCH:
-                        # read the WHOLE request before touching the queue:
-                        # an error mid-put (closed transport) must not leave
-                        # half the request unread and desync the stream
-                        (count,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        batch = []
-                        for _ in range(count):
-                            (n,) = struct.unpack("<I", _recv_exact(conn, 4))
-                            batch.append(_recv_payload(conn, n, self._pool))
-                        if TRACER.enabled:
-                            for item in batch:
-                                _stamp_relay_arrival(item)
-                        if self._draining:
-                            conn.sendall(_ST_CLOSED)
-                            continue
-                        accepted = 0
-                        for item in batch:
-                            if not queue.put(item):
-                                break  # full: accepted prefix only (FIFO)
-                            accepted += 1
-                        conn.sendall(_ST_OK + struct.pack("<I", accepted))
-                    elif op == _OP_SIZE:
-                        conn.sendall(_ST_OK + struct.pack("<I", queue.size()))
-                    elif op == _OP_STATS:
-                        payload = json.dumps(_queue_stats_payload(queue)).encode()
-                        conn.sendall(_ST_OK + struct.pack("<I", len(payload)) + payload)
-                    elif op == _OP_ANCHOR:
-                        # clock ping/anchor exchange (trace alignment):
-                        # read the client's pair, answer with ours — the
-                        # client brackets our reply between two local
-                        # samples and records the exchange to its spool
-                        _recv_exact(conn, 16)  # client wall+mono (RTT symmetry)
-                        conn.sendall(
-                            _ST_OK
-                            + struct.pack("<dd", time.time(), time.monotonic())
-                        )
-                    elif op == _OP_CLOSE:
-                        queue.close()
-                        conn.sendall(_ST_OK)
-                    elif op == _OP_BYE:
-                        return  # clean goodbye: previous response is ACKed
-                    elif op == _OP_OPEN:
-                        (ns_len,) = struct.unpack("<H", _recv_exact(conn, 2))
-                        ns = _recv_exact(conn, ns_len).decode()
-                        (nm_len,) = struct.unpack("<H", _recv_exact(conn, 2))
-                        nm = _recv_exact(conn, nm_len).decode()
-                        (maxsize,) = struct.unpack("<I", _recv_exact(conn, 4))
-                        queue = self.open_named(ns, nm, maxsize or None)
-                        conn.sendall(_ST_OK)
-                    else:
-                        conn.sendall(_ST_ERR)
-                        return
-                except TransportClosed:
-                    conn.sendall(_ST_CLOSED)
-        except (ConnectionError, OSError):
-            self._requeue(queue, in_flight)
-        finally:
-            conn.close()
 
     def shutdown(self):
         self._stop.set()
@@ -1533,6 +1201,39 @@ class TcpQueueClient:
             deadline = time.monotonic() + self.PROBE_DEADLINE_S
         with self._lock:
             return self._retrying(_do, deadline)
+
+    def cluster_rpc(self, payload: dict, deadline: Optional[float] = None) -> dict:
+        """Consumer-group coordination RPC (opcode 'N'): send one JSON
+        request to the server's :class:`psana_ray_tpu.cluster.
+        coordinator.GroupRegistry` and return its JSON answer. Control
+        plane, so it fails fast like the other probes (PROBE_DEADLINE_S)
+        — a dead coordinator must surface as TransportClosed promptly,
+        not hang a rebalance behind the full reconnect envelope."""
+        import time
+
+        if self._stream is not None:  # would desync the push framing
+            return self._side_channel().cluster_rpc(payload, deadline)
+        body = json.dumps(payload).encode()
+
+        def _do():
+            self._sock.sendall(_OP_CLUSTER + struct.pack("<I", len(body)) + body)
+            self._status()
+            (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return json.loads(_recv_exact(self._sock, n).decode())
+
+        if deadline is None:
+            deadline = time.monotonic() + self.PROBE_DEADLINE_S
+        with self._lock:
+            return self._retrying(_do, deadline)
+
+    def unacked_puts(self) -> List[Any]:
+        """Snapshot of the windowed-put items not yet acknowledged by
+        THIS server, oldest first. The cluster client reads it when a
+        server dies for good (reconnects exhausted): the tail must be
+        resent to the partition's NEW owner — the PR 5 resend invariant
+        carried across servers (duplicates possible, holes never)."""
+        with self._lock:
+            return [item for (_seq, item) in self._put_unacked]
 
     def close_remote(self):
         """Close the remote queue (fault-injection / teardown)."""

@@ -1,0 +1,34 @@
+"""KNOWN-BAD: a sleep smuggled into a cluster merge drain.
+
+The batcher's drain loop reaches the cluster client through the same
+``get_batch_stream`` seed edge as the single-server stream reader, so a
+``time.sleep`` pacing the partition sweep — instead of a caller-bounded
+socket timeout or an interruptible Event wait — must flag as a stall in
+the audited graph (blocking-hot-path)."""
+
+import time
+
+
+def batches_from_queue(queue, batch_size):
+    pop = getattr(queue, "get_batch_stream", None) or queue.get_batch
+    while True:
+        items = pop(batch_size, timeout=0.01)
+        if not items:
+            return
+        yield items
+
+
+class ClusterishClient:
+    def get_batch_stream(self, max_items, timeout=None):
+        return self._merge_drain(max_items, timeout)
+
+    def _merge_drain(self, max_items, timeout):
+        out = []
+        for p in self._partitions:
+            out.extend(self._pop(p, max_items - len(out), 0.0))
+            if not out:
+                time.sleep(0.05)  # MUST FLAG: unbounded pacing in the drain
+        return out
+
+    def _pop(self, p, n, t):
+        return self._clients[p].get_batch(n, timeout=t)

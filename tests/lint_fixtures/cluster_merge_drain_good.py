@@ -1,0 +1,45 @@
+"""SANCTIONED: the cluster merge drain's bounded-wait idioms.
+
+Sweeping partitions with ``timeout=0.0`` pops, blocking one
+caller-bounded slice on a rotating partition, and pausing an idle
+member on an interruptible ``Event.wait`` are all deadline-bounded —
+none may flag (blocking-hot-path)."""
+
+import threading
+import time
+
+
+def batches_from_queue(queue, batch_size):
+    pop = getattr(queue, "get_batch_stream", None) or queue.get_batch
+    while True:
+        items = pop(batch_size, timeout=0.01)
+        if not items:
+            return
+        yield items
+
+
+class ClusterishClient:
+    def __init__(self):
+        self._idle = threading.Event()
+
+    def get_batch_stream(self, max_items, timeout=None):
+        return self._merge_drain(max_items, timeout)
+
+    def _merge_drain(self, max_items, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        while True:
+            for p in self._partitions:
+                out.extend(self._pop(p, max_items - len(out), 0.0))
+            if out:
+                return out
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return []
+            if not self._partitions:
+                self._idle.wait(0.05)  # interruptible, bounded pause
+                continue
+            out.extend(self._pop(self._partitions[0], max_items, 0.05))
+
+    def _pop(self, p, n, t):
+        return self._clients[p].get_batch(n, timeout=t)

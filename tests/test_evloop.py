@@ -1,6 +1,7 @@
 """Event-loop queue server (ISSUE 6): connection scaling with O(1)
 threads, admission control, bounded waits as timer state, and
-crash-redelivery parity across both server modes.
+crash-redelivery. (The legacy thread-per-connection mode is removed —
+ISSUE 7; its unique redelivery/admission coverage is folded in here.)
 
 The C10K-style scaling tests drive raw streamed-subscriber sockets off
 one client-side selector (a full TcpQueueClient per subscriber would
@@ -127,15 +128,13 @@ class TestEventLoopBasics:
         finally:
             srv.shutdown()
 
-    def test_threads_mode_available_behind_flag(self):
-        q, srv = _mk(mode="threads")
-        try:
-            assert srv.mode == "threads" and srv._loop is None
-            c = TcpQueueClient("127.0.0.1", srv.port)
-            assert c.put({"x": 1}) and c.get() == {"x": 1}
-            c.disconnect()
-        finally:
-            srv.shutdown()
+    def test_threads_mode_is_removed(self):
+        # ISSUE 7 satellite: the legacy thread-per-connection mode was
+        # scheduled for deletion one release after the event loop became
+        # the default — asking for it must fail loudly, not silently
+        # fall back (its unique coverage lives in the suites below now)
+        with pytest.raises(ValueError, match="threads"):
+            TcpQueueServer(RingBuffer(4), host="127.0.0.1", mode="threads")
 
     def test_bounded_wait_is_timer_state_not_a_thread(self):
         """'D' against an empty queue must honor its deadline through the
@@ -175,9 +174,8 @@ class TestEventLoopBasics:
 
 
 class TestAdmissionControl:
-    @pytest.mark.parametrize("mode", ["evloop", "threads"])
-    def test_max_conns_refuses_with_protocol_error(self, mode):
-        q, srv = _mk(mode=mode, max_conns=2)
+    def test_max_conns_refuses_with_protocol_error(self):
+        q, srv = _mk(max_conns=2)
         try:
             refused0 = EVLOOP.stats()["refused_total"]
             c1 = TcpQueueClient("127.0.0.1", srv.port)
@@ -187,8 +185,7 @@ class TestAdmissionControl:
                                 reconnect_base_s=0.01)
             with pytest.raises((RuntimeError, TransportClosed)):
                 c3.size()  # the refusal 'E' surfaces on first use
-            if mode == "evloop":
-                assert EVLOOP.stats()["refused_total"] > refused0
+            assert EVLOOP.stats()["refused_total"] > refused0
             # admitted clients keep working through the refusal
             assert c2.get() == {"a": 1}
             c1.disconnect()
@@ -219,16 +216,16 @@ class TestAdmissionControl:
             srv.shutdown()
 
 
-class TestRedeliveryModeMatrix:
-    """The at-least-once contract must hold identically in both server
-    modes: kill a streaming consumer mid-window and exactly the unacked
-    tail redelivers."""
+class TestRedelivery:
+    """The at-least-once contract (formerly pinned across BOTH server
+    modes; the threads mode is gone and this is its folded-in unique
+    coverage): kill a streaming consumer mid-window and exactly the
+    unacked tail redelivers."""
 
-    @pytest.mark.parametrize("mode", ["evloop", "threads"])
-    def test_kill_after_partial_ack_redelivers_exactly_the_tail(self, mode):
+    def test_kill_after_partial_ack_redelivers_exactly_the_tail(self):
         import numpy as np
 
-        q, srv = _mk(maxsize=64, mode=mode)
+        q, srv = _mk(maxsize=64)
         try:
             for i in range(10):
                 q.put(FrameRecord(0, i, np.full((1, 8, 8), float(i), np.float32), 1.0))
@@ -257,9 +254,8 @@ class TestRedeliveryModeMatrix:
         finally:
             srv.shutdown()
 
-    @pytest.mark.parametrize("mode", ["evloop", "threads"])
-    def test_unacked_get_requeues_on_death(self, mode):
-        q, srv = _mk(maxsize=8, mode=mode)
+    def test_unacked_get_requeues_on_death(self):
+        q, srv = _mk(maxsize=8)
         try:
             q.put({"k": 5})
             c = TcpQueueClient("127.0.0.1", srv.port)

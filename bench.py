@@ -859,6 +859,17 @@ def main():
         lambda: _bench_cluster_scaling(extras, smoke),
     )
 
+    # ---------------- durability: segment-log overhead + kill-restart ----
+    # device-free (ISSUE 8): relay fps log-off vs fsync=none vs
+    # fsync=batch (the durability tax, measured not guessed) and a
+    # kill -9 + restart row whose `lost` MUST be 0 with resume at the
+    # committed offset
+    run_section(
+        wd,
+        "durability",
+        lambda: _bench_durability(extras, smoke),
+    )
+
     # ---------------- config 5: multi-detector fan-in --------------------
     # two independent sections: the kHz HOST demonstration must not lose
     # its number to a tunnel-bound device leg timing out (round-3 run:
@@ -2320,6 +2331,203 @@ def _bench_host_datapath(extras, smoke=False):
         f"flight, {occupancy['acks']} acks, "
         f"{occupancy['redelivered']} redelivered)"
     )
+
+
+def _bench_durability(extras, smoke=False):
+    """Durability accounting (ISSUE 8, no device):
+
+    - ``durability_overhead``: relay fps through one queue server with
+      the segment log OFF vs ``fsync=none`` vs ``fsync=batch`` on
+      detector-native u16 frames — the measured durability tax, plus
+      RELAY-ADDED copies/frame per row: the log-off relay itself adds
+      0.00 (pure zero-copy; the consumer batch-arena copy that makes
+      the end-to-end pin 1.00 lives downstream, measured in
+      host-datapath), and a log-on row pays EXACTLY +1.00 — the one
+      ``encode_into`` memcpy into the mmap'd segment, no intermediate
+      bytes.
+    - ``durability_kill_restart``: a REAL ``kill -9`` of a durable
+      queue-server subprocess mid-stream, restart on the same
+      ``--durable_dir``, drain: ``lost`` MUST be 0 and consumption must
+      resume at the committed offset (duplicates allowed, holes never).
+      Records the recovery wall time (boot scan + re-expose included).
+    """
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading as _threading
+
+    from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
+    from psana_ray_tpu.storage import DurableRingBuffer, SegmentLog
+    from psana_ray_tpu.transport import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+    from psana_ray_tpu.utils.bufpool import WIRE
+
+    shape = (2, 32, 32) if smoke else (16, 352, 384)  # epix10k2M u16
+    n_frames = 24 if smoke else 120
+    seg_bytes = (1 << 22) if smoke else (1 << 26)
+    rng = np.random.default_rng(11)
+    pool16 = [rng.integers(0, 4096, size=shape, dtype=np.uint16) for _ in range(4)]
+    scratch = tempfile.mkdtemp(prefix="bench_durable_")
+
+    def run_relay(mode: str):
+        """One producer->server->consumer pass; fps + copies/frame."""
+        if mode == "log-off":
+            backing = RingBuffer(32)
+        else:
+            log = SegmentLog(
+                os.path.join(scratch, f"overhead_{mode}"),
+                segment_bytes=seg_bytes, fsync=mode, name=mode,
+            )
+            backing = DurableRingBuffer(log, maxsize=32, name=mode)
+        srv = TcpQueueServer(backing, host="127.0.0.1").serve_background()
+        prod = TcpQueueClient("127.0.0.1", srv.port)
+        cons = TcpQueueClient("127.0.0.1", srv.port)
+        try:
+            def produce():
+                for i in range(n_frames):
+                    rec = FrameRecord(0, i, pool16[i % 4], 9.5)
+                    if not prod.put_pipelined(rec, deadline=time.monotonic() + 120):
+                        raise RuntimeError("producer starved out")
+                if not prod.flush_puts(deadline=time.monotonic() + 120):
+                    raise RuntimeError("put window never drained")
+                if not prod.put_wait(EndOfStream(total_events=n_frames), timeout=120):
+                    raise RuntimeError("EOS delivery timed out")
+
+            c0 = WIRE.stats()
+            t = _threading.Thread(target=produce, daemon=True)
+            seen = 0
+            t0 = time.perf_counter()
+            t.start()
+            while True:
+                batch = cons.get_batch(16, timeout=10.0)
+                if not batch:
+                    break
+                if any(is_eos(x) for x in batch):
+                    seen += sum(0 if is_eos(x) else 1 for x in batch)
+                    break
+                seen += len(batch)
+            dt = time.perf_counter() - t0
+            t.join(timeout=10)
+            c1 = WIRE.stats()
+            copies = (c1["copies_total"] - c0["copies_total"]) / max(1, seen)
+            if seen != n_frames:
+                raise RuntimeError(f"relay saw {seen}/{n_frames} frames")
+            return seen / dt, copies
+        finally:
+            for c in (prod, cons):
+                try:
+                    c.disconnect()
+                except Exception:
+                    pass
+            srv.shutdown()
+            log_ = getattr(backing, "log", None)
+            if log_ is not None:
+                log_.close()
+
+    rows = []
+    for mode in ("log-off", "none", "batch"):
+        fps, copies = run_relay(mode)
+        rows.append({
+            "mode": mode, "fps": round(fps, 1),
+            "copies_per_frame": round(copies, 3),
+        })
+        log(
+            f"durability [relay, u16 {shape}, fsync={mode}]: {fps:.0f} fps, "
+            f"{copies:.2f} copies/frame"
+        )
+    base = rows[0]["fps"]
+    if base > 0:
+        for row in rows[1:]:
+            row["overhead_pct"] = round(100.0 * (1 - row["fps"] / base), 1)
+    extras["durability_overhead"] = rows
+
+    # -- kill -9 + restart row (lost MUST be 0) ---------------------------
+    durable_dir = os.path.join(scratch, "kill")
+    port_file = os.path.join(scratch, "port")
+    kill_frames = 16 if smoke else 80
+
+    def start_server():
+        if os.path.exists(port_file):
+            os.remove(port_file)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "psana_ray_tpu.queue_server",
+                "--port", "0", "--durable_dir", durable_dir,
+                "--fsync", "batch", "--fsync_batch_n", "8",
+                "--port_file", port_file, "--stall_poll_s", "0",
+                "--queue_size", "500", "--segment_bytes", str(seg_bytes),
+            ],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + 60
+        while not os.path.exists(port_file):
+            if proc.poll() is not None or time.monotonic() > deadline:
+                raise RuntimeError("durable queue server failed to start")
+            time.sleep(0.05)
+        return proc, int(open(port_file).read())
+
+    row = {"produced": kill_frames, "lost": -1}
+    proc = None
+    try:
+        proc, port = start_server()
+        prod = TcpQueueClient("127.0.0.1", port, reconnect_tries=1)
+        for i in range(kill_frames):
+            if not prod.put_pipelined(
+                FrameRecord(0, i, pool16[i % 4], 9.5),
+                deadline=time.monotonic() + 60,
+            ):
+                raise RuntimeError("producer starved out")
+        if not prod.flush_puts(deadline=time.monotonic() + 60):
+            raise RuntimeError("put window never drained")
+        cons = TcpQueueClient("127.0.0.1", port, reconnect_tries=1)
+        first = cons.get_batch(kill_frames // 3, timeout=30.0)
+        cons.size()  # implicit-ack: the committed offset moves
+        consumed = [r.event_idx for r in first]
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        t0 = time.monotonic()
+        proc, port = start_server()
+        cons2 = TcpQueueClient("127.0.0.1", port, reconnect_tries=1)
+        recovered = []
+        while True:
+            batch = cons2.get_batch(64, timeout=1.0)
+            if not batch:
+                break
+            recovered.extend(r.event_idx for r in batch)
+        recovery_s = time.monotonic() - t0
+        all_seen = set(consumed) | set(recovered)
+        row = {
+            "produced": kill_frames,
+            "consumed_before_kill": len(consumed),
+            "recovered_after_restart": len(recovered),
+            "duplicates": len(consumed) + len(recovered) - len(all_seen),
+            "lost": kill_frames - len(all_seen),
+            "resume_offset": min(recovered) if recovered else None,
+            "recovery_s": round(recovery_s, 3),
+        }
+        for c in (prod, cons2):
+            try:
+                c.disconnect()
+            except Exception:
+                pass
+        log(
+            f"durability [kill -9 + restart]: {row['lost']} lost "
+            f"(MUST be 0), resumed at offset {row['resume_offset']} after "
+            f"consuming {row['consumed_before_kill']}, "
+            f"{row['duplicates']} dup(s), recovery {row['recovery_s']}s"
+        )
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(scratch, ignore_errors=True)
+    extras["durability_kill_restart"] = row
 
 
 def _bench_connection_scaling(extras, smoke=False):

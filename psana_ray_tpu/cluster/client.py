@@ -149,6 +149,17 @@ class ClusterClient:
         self._rr = 0  # round-robin put cursor  # guarded-by: _lock
         self._scan = 0  # merge-drain rotation cursor  # guarded-by: _lock
         self._streaming = False  # guarded-by: _lock
+        # durable replay (ISSUE 8): (from, group) applied to each
+        # partition connection on first consumer use — per-partition
+        # segment logs have per-partition offsets, so "from=<N>" is a
+        # per-partition position; "begin"/"resume" do what they say on
+        # every partition
+        self._replay: Optional[tuple] = None  # guarded-by: _lock
+        # server address -> bool: whether that server mounts durable
+        # queues (fixed for a server's lifetime) — probed once, so the
+        # drained-commit offset lookup costs memory-only clusters zero
+        # extra RPCs
+        self._durable_servers: Dict[str, bool] = {}  # guarded-by: _lock
         self._tallies: Dict[int, EosTally] = {}  # guarded-by: _lock
         self._drained: set = set()  # guarded-by: _lock
         # drained partitions whose group-wide commit was FENCED and must
@@ -196,17 +207,144 @@ class ClusterClient:
     def add_server(self, address: str) -> int:
         """Grow the cluster: recompute the map over the widened live set
         (rendezvous hashing moves ~1/N of partitions to the newcomer).
-        Returns how many partitions moved. Frames already queued at a
-        moved partition's OLD owner are not migrated — add servers
-        before the stream starts (or between runs); mid-stream growth is
-        a durability feature the segment-log roadmap item owns."""
+        Returns how many partitions moved.
+
+        LOG-BACKED partitions (servers started with --durable_dir,
+        ISSUE 8) migrate their queued backlog: the old owner — alive, by
+        definition of an ADD — drains each moved partition's retained
+        unconsumed range to the new owner before this call returns, so
+        mid-stream growth strands nothing (duplicates possible as ever:
+        a frame popped for migration rides the windowed-put resend
+        contract to the new owner). Memory-only partitions keep the
+        PR 7 documented limit: frames already queued at the old owner
+        are not migrated — add memory-only servers between runs."""
         with self._lock:
             if address in self._addresses:
                 return 0
             self._addresses.append(address)
-            return self._apply_map(self._map.recompute(
+            new_map = self._map.recompute(
                 [a for a in self._addresses if a not in self._dead]
-            ))
+            )
+            moved = new_map.moved_from(self._map)
+            old_owners = {p: self._map.assignments[p] for p in moved}
+            n = self._apply_map(new_map)
+        # migrate OUTSIDE the client lock: the drain is bounded network
+        # work (_MIGRATE_DEADLINE_S per partition), and holding the lock
+        # through it would stall every other op on this client AND
+        # starve the group-heartbeat thread past its lease (a rebalance
+        # storm, the exact failure the lease keepalive exists to avoid).
+        # Concurrent ops already see the new map; migration only adds
+        # the old owner's backlog on top.
+        if old_owners:
+            self._migrate_moved_partitions(old_owners)
+        return n
+
+    # per-partition wall-clock bound on one migration drain: add_server
+    # is an admin op but it runs under the client lock — a full/slow new
+    # owner defers the remainder through _resend_pending instead of
+    # wedging every other op on this client
+    _MIGRATE_DEADLINE_S = 20.0
+
+    def _migrate_moved_partitions(self, old_owners: Dict[int, str]) -> None:
+        """Drain each moved partition's queued backlog from its (alive)
+        old owner into the new owner — log-backed queues only (the old
+        owner's stats announce ``durable``); memory-only partitions keep
+        the documented no-migration limit.
+
+        Ack discipline (holes never): a batch popped from the old owner
+        is implicitly ACKed there ONLY AFTER the new owner has
+        acknowledged every frame of it (``flush_puts``) — a crash
+        mid-migration leaves the batch unacked on the old owner, which
+        redelivers it (duplicates possible, loss never). Frames that
+        cannot be confirmed within the bounded window go to the
+        standard deferred-resend queue and the old-owner copy stays
+        unacked.
+
+        Runs WITHOUT the cluster lock held (add_server releases it
+        first — holding it through a bounded network drain would stall
+        every other op and starve the heartbeat lease); per-op locking
+        happens inside _with_failover and the explicit _resend_pending
+        mutation."""
+        for p, addr in sorted(old_owners.items()):
+            host, _, port = addr.rpartition(":")
+            try:
+                old = TcpQueueClient(
+                    host, int(port),
+                    timeout_s=min(self._timeout_s, 10.0),
+                    namespace=self.namespace,
+                    queue_name=partition_queue_name(self.queue_name, p),
+                    reconnect_tries=1, reconnect_base_s=0.1,
+                    pool=self._pool,
+                )
+            except TransportClosed:
+                continue  # old owner gone after all: nothing to drain
+            migrated = 0
+            confirmed = True
+            deadline = time.monotonic() + self._MIGRATE_DEADLINE_S
+            try:
+                if not old.stats().get("durable"):
+                    FLIGHT.record(
+                        "cluster_migrate_skipped", partition=p,
+                        reason="memory-only",
+                    )
+                    continue
+                while time.monotonic() < deadline:
+                    batch = old.get_batch(64, timeout=0.25)
+                    if not batch:
+                        break
+                    sent_all = True
+                    for i, item in enumerate(batch):
+                        # new owner via the freshly applied map; windowed
+                        # puts so the at-least-once resend contract rides
+                        if not self._with_failover(
+                            p,
+                            lambda c, _i=item: c.put_pipelined(
+                                _i, deadline=deadline
+                            ),
+                        ):
+                            # window full at the bound: defer the rest
+                            # through the standard resend machinery (the
+                            # old-owner copies ALSO stay unacked — dupes
+                            # possible, holes never)
+                            with self._lock:
+                                pending = self._resend_pending.setdefault(
+                                    p, []
+                                )
+                                pending.extend(batch[i:])
+                            sent_all = False
+                            break
+                    ok = sent_all and self._with_failover(
+                        p, lambda c: c.flush_puts(deadline=deadline)
+                    )
+                    if not ok:
+                        confirmed = False
+                        break
+                    migrated += len(batch)
+                    # only NOW is the batch safe on the new owner: the
+                    # implicit ack may advance the old owner's floor
+                    old.size()
+            except TransportClosed:
+                confirmed = False  # partial drain: old owner redelivers
+            finally:
+                if confirmed:
+                    try:
+                        old.disconnect()  # BYE: acks the final delivery
+                    except Exception:  # noqa: BLE001 — already closing
+                        _close_quietly(old)
+                else:
+                    # NEVER send BYE here: it would ack a delivery the
+                    # new owner has not confirmed
+                    _close_quietly(old)
+                    FLIGHT.record(
+                        "cluster_migrate_deferred", partition=p,
+                        migrated=migrated,
+                    )
+            if migrated:
+                CLUSTER.resent(0, migrated)
+                FLIGHT.record(
+                    "cluster_partition_migrated", partition=p,
+                    frames=migrated, from_server=addr,
+                )
 
     def _apply_map(self, new_map: PartitionMap) -> int:
         """Swap in a recomputed map; drop connections of moved
@@ -481,6 +619,18 @@ class ClusterClient:
         return True
 
     # -- consumer surface --------------------------------------------------
+    def replay_open(self, from_offset=None, group: str = "replay") -> "ClusterClient":
+        """Durable clusters: switch the drain surface to NON-destructive
+        replay of every assigned partition's retained segment-log range
+        under ``group`` — live consumers are undisturbed, progress
+        commits per partition at the connections' implicit-ACK points.
+        ``from_offset``: ``"begin"`` / ``"resume"`` / per-partition
+        offset int (each partition's log has its own offset space)."""
+        with self._lock:
+            self._replay = (from_offset, group)
+            self._streaming = False  # replay is pull-mode by design
+        return self
+
     def stream_open(self, window: int = 0) -> "ClusterClient":
         """Switch the drain surface to merged server-push streams: each
         assigned partition's connection subscribes (lazily, on first
@@ -630,6 +780,9 @@ class ClusterClient:
         def _do(c: TcpQueueClient):
             with self._lock:
                 self._held.add(p)
+                replay = self._replay
+            if replay is not None and c._replay_args is None:
+                c.replay_open(replay[0], group=replay[1])
             if self._streaming:
                 if c._stream is None:
                     c.stream_open(self._stream_window)
@@ -670,7 +823,29 @@ class ClusterClient:
             pass
         with self._lock:
             session = self._session
-        if session is not None and not session.commit_drained(p):
+        offset = None
+        if session is not None:
+            # durable partitions: the drained commit CARRIES the
+            # partition's committed log offset, so the coordinator's
+            # persisted group state records how far consumption provably
+            # reached (recovered on coordinator restart). Durability is
+            # fixed per server, so a memory-only server is probed ONCE,
+            # not once per drained partition.
+            with self._lock:
+                addr = self._map.assignments.get(p)
+                known = self._durable_servers.get(addr)
+            if known is not False:
+                try:
+                    st = self._with_failover(p, lambda c: c.stats())
+                    durable = bool(st.get("durable"))
+                    with self._lock:
+                        if addr is not None:
+                            self._durable_servers[addr] = durable
+                    if durable:
+                        offset = st.get("committed_offset")
+                except TransportClosed:
+                    offset = None
+        if session is not None and not session.commit_drained(p, offset=offset):
             # FENCED: the commit is deferred to the new generation, not
             # dropped — the markers are already consumed, so if nobody
             # retries, no member can ever commit p and the group EOS

@@ -22,10 +22,19 @@ in-flight re-enqueues at the queue head for the new owner
 
 Liveness: members must heartbeat within ``session_timeout_s``; every
 request sweeps expired members first (no timer thread — the registry is
-passive state behind the RPC). Deliberately NOT persistent: a
-coordinator restart empties the registry, members observe the unknown-
-group answer and rejoin — generations restart, which is safe because a
-fresh coordinator also has no stale state to fence against.
+passive state behind the RPC).
+
+Persistence (ISSUE 8): with ``store_path`` set (the queue server passes
+a file under ``--durable_dir``), every mutation snapshots the group
+CONTROL state — generation, partition count, drained partitions and
+their committed offsets — atomically to disk, and a restarted
+coordinator recovers it: generations continue monotonically (stale
+members stay fenced instead of colliding with a reset counter), drain
+progress and offsets survive, and members simply rejoin (leases are
+process liveness, never persisted). This shrinks PR 7's documented
+"coordinator not replicated" limit from "restart loses the group" to
+"restart costs a rejoin". Without a store the registry keeps the old
+memory-only behavior: restart empties it, members rejoin from scratch.
 
 This module is stdlib-only (no transport imports): the server side of
 the RPC hands it decoded JSON dicts and sends back what it returns.
@@ -33,9 +42,11 @@ the RPC hands it decoded JSON dicts and sends back what it returns.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 
 # default member-lease length: generous against stop-the-world pauses
@@ -46,13 +57,26 @@ DEFAULT_SESSION_TIMEOUT_S = 10.0
 
 
 class _Group:
-    __slots__ = ("generation", "members", "drained", "n_partitions")
+    __slots__ = (
+        "generation", "members", "drained", "n_partitions", "offsets",
+        "recovered_pending",
+    )
 
     def __init__(self):
         self.generation = 0
         self.members: Dict[str, float] = {}  # member_id -> last_seen mono
         self.drained: set = set()  # partitions committed fully drained
         self.n_partitions = 0
+        # partition -> committed log offset carried by drained commits
+        # (durable clusters): what a recovering/rebalanced owner may
+        # treat as consumed on that partition's segment log
+        self.offsets: Dict[int, int] = {}
+        # True between _load() and the first join: a recovered group's
+        # empty member list means "awaiting rejoin", NOT "finished run
+        # reusing the name" — the new-epoch wipe must not fire on it
+        # (mid-stream drain progress would be unrecoverable: the EOS
+        # markers are already consumed)
+        self.recovered_pending = False
 
 
 class GroupRegistry:
@@ -78,10 +102,71 @@ class GroupRegistry:
     - ``{"op": "info", "group": g}`` -> current state, no mutation
     """
 
-    def __init__(self, session_timeout_s: float = DEFAULT_SESSION_TIMEOUT_S):
+    def __init__(
+        self,
+        session_timeout_s: float = DEFAULT_SESSION_TIMEOUT_S,
+        store_path: Optional[str] = None,
+    ):
         self.session_timeout_s = session_timeout_s
         self._lock = threading.Lock()
         self._groups: Dict[str, _Group] = {}  # guarded-by: _lock
+        self._store_path = store_path
+        self._dirty = False  # mutation since last persist  # guarded-by: _lock
+        if store_path:
+            self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        """Recover control state from the snapshot: generation continues
+        monotonically (stale members stay fenced), drain progress and
+        per-partition offsets survive. Member leases are liveness, not
+        state — members rejoin."""
+        try:
+            with open(self._store_path, "r") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            for name, st in data.get("groups", {}).items():
+                g = _Group()
+                g.generation = int(st.get("generation", 0))
+                g.n_partitions = int(st.get("n_partitions", 0))
+                g.drained = {int(p) for p in st.get("drained", ())}
+                g.offsets = {
+                    int(p): int(o) for p, o in st.get("offsets", {}).items()
+                }
+                g.recovered_pending = True
+                self._groups[name] = g
+
+    def _persist(self) -> None:
+        """Atomic snapshot of the control state after a mutation (rare:
+        membership changes and drain commits, never heartbeats). Runs
+        ONCE per mutating RPC — branches mark ``_dirty`` and
+        :meth:`handle` flushes, so a join that also sweeps an expired
+        member costs one fsync'd snapshot, not two."""
+        # guarded-by-caller: _lock
+        if not self._store_path:
+            return
+        data = {
+            "groups": {
+                name: {
+                    "generation": g.generation,
+                    "n_partitions": g.n_partitions,
+                    "drained": sorted(g.drained),
+                    "offsets": {str(p): o for p, o in g.offsets.items()},
+                }
+                for name, g in self._groups.items()
+            }
+        }
+        tmp = self._store_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._store_path)
+        except OSError:
+            pass  # persistence is best-effort; the RPC answer must land
 
     # -- the RPC entry point ----------------------------------------------
     def handle(self, req: dict) -> dict:
@@ -91,58 +176,93 @@ class GroupRegistry:
             return {"ok": False, "error": "missing group"}
         member = req.get("member")
         with self._lock:
-            g = self._groups.get(group)
-            if op == "join":
-                if g is None:
-                    g = self._groups[group] = _Group()
-                self._sweep(g)
-                # validate BEFORE enrolling: a refused join must leave
-                # no trace — enrolling first would hand a misconfigured
-                # (and client-side crashed) member a partition share it
-                # will never drain, starving those partitions for a full
-                # lease, and fence every healthy member for nothing
-                n_parts = int(req.get("n_partitions") or 0)
-                if n_parts > 0 and g.n_partitions and g.n_partitions != n_parts:
-                    return {
-                        "ok": False,
-                        "error": f"group {group!r} was created with "
-                        f"n_partitions={g.n_partitions}, not {n_parts}",
-                    }
-                if not g.members and g.drained:
-                    # a join into an EMPTY group starts a new stream
-                    # epoch: stale drained state from a previous run
-                    # reusing this group name would otherwise hand the
-                    # new members an instant (bogus) end-of-stream and
-                    # silently strand every frame of the new stream
-                    g.drained.clear()
-                    g.generation += 1
-                if member not in g.members:
-                    g.generation += 1
-                g.members[member] = time.monotonic()
-                if n_parts > 0:
-                    g.n_partitions = n_parts
-                return self._state(g, ok=True)
+            try:
+                return self._dispatch(op, group, member, req)
+            finally:
+                if self._dirty:
+                    self._dirty = False
+                    self._persist()
+
+    def _dispatch(self, op, group, member, req: dict) -> dict:
+        # guarded-by-caller: _lock
+        g = self._groups.get(group)
+        if op == "join":
             if g is None:
-                return {"ok": False, "unknown_group": True}
+                g = self._groups[group] = _Group()
             self._sweep(g)
-            if op == "heartbeat":
-                return self._fenced_touch(g, member, req)
-            if op == "leave":
-                if member in g.members:
-                    del g.members[member]
-                    g.generation += 1
+            # validate BEFORE enrolling: a refused join must leave
+            # no trace — enrolling first would hand a misconfigured
+            # (and client-side crashed) member a partition share it
+            # will never drain, starving those partitions for a full
+            # lease, and fence every healthy member for nothing
+            n_parts = int(req.get("n_partitions") or 0)
+            if n_parts > 0 and g.n_partitions and g.n_partitions != n_parts:
+                return {
+                    "ok": False,
+                    "error": f"group {group!r} was created with "
+                    f"n_partitions={g.n_partitions}, not {n_parts}",
+                }
+            drained_complete = (
+                g.n_partitions > 0 and len(g.drained) >= g.n_partitions
+            )
+            if not g.members and g.drained and (
+                not g.recovered_pending or drained_complete
+            ):
+                # a join into an EMPTY group starts a new stream
+                # epoch: stale drained state from a previous run
+                # reusing this group name would otherwise hand the
+                # new members an instant (bogus) end-of-stream and
+                # silently strand every frame of the new stream.
+                # EXCEPT a just-recovered group with a PARTIAL drain
+                # set: its empty member list means "coordinator
+                # restarted, members rejoining" — wiping would strand
+                # the drained partitions forever (their EOS markers
+                # are consumed; nobody can re-commit them). A
+                # recovered group whose drain is COMPLETE is a
+                # finished run: name reuse there is a new epoch.
+                g.drained.clear()
+                g.offsets.clear()
+                g.generation += 1
+            g.recovered_pending = False
+            if member not in g.members:
+                g.generation += 1
+            g.members[member] = time.monotonic()
+            if n_parts > 0:
+                g.n_partitions = n_parts
+            self._dirty = True
+            return self._state(g, ok=True)
+        if g is None:
+            return {"ok": False, "unknown_group": True}
+        self._sweep(g)
+        if op == "heartbeat":
+            return self._fenced_touch(g, member, req)
+        if op == "leave":
+            if member in g.members:
+                del g.members[member]
+                g.generation += 1
+                self._dirty = True
+            return self._state(g, ok=True)
+        if op == "drained":
+            out = self._fenced_touch(g, member, req)
+            if out.get("ok"):
+                p = int(req.get("partition", -1))
+                if 0 <= p and (not g.n_partitions or p < g.n_partitions):
+                    g.drained.add(p)
+                    # durable clusters: the commit carries the
+                    # partition's committed log offset, so a
+                    # recovered coordinator knows how far the
+                    # group's consumption provably reached
+                    off = req.get("offset")
+                    if off is not None:
+                        g.offsets[p] = max(
+                            int(off), g.offsets.get(p, -1)
+                        )
+                    self._dirty = True
                 return self._state(g, ok=True)
-            if op == "drained":
-                out = self._fenced_touch(g, member, req)
-                if out.get("ok"):
-                    p = int(req.get("partition", -1))
-                    if 0 <= p and (not g.n_partitions or p < g.n_partitions):
-                        g.drained.add(p)
-                    return self._state(g, ok=True)
-                return out
-            if op == "info":
-                return self._state(g, ok=True)
-            return {"ok": False, "error": f"unknown op {op!r}"}
+            return out
+        if op == "info":
+            return self._state(g, ok=True)
+        return {"ok": False, "error": f"unknown op {op!r}"}
 
     # -- internals (caller holds _lock) -----------------------------------
     def _sweep(self, g: _Group) -> None:
@@ -156,6 +276,7 @@ class GroupRegistry:
             del g.members[m]
         if dead:
             g.generation += 1
+            self._dirty = True
 
     def _fenced_touch(self, g: _Group, member, req: dict) -> dict:
         """Refresh ``member``'s lease iff its generation is current and
@@ -177,6 +298,8 @@ class GroupRegistry:
             "drained": sorted(g.drained),
             "n_partitions": g.n_partitions,
         }
+        if g.offsets:
+            out["offsets"] = {str(p): o for p, o in sorted(g.offsets.items())}
         if fenced:
             out["fenced"] = True
         return out

@@ -103,21 +103,28 @@ class GroupSession:
             return self.join_group()
         return self._absorb(resp)
 
-    def commit_drained(self, partition: int) -> bool:
+    def commit_drained(self, partition: int, offset: Optional[int] = None) -> bool:
         """Generation-fenced commit that ``partition`` completed its EOS
         tally — group-wide, so the drain survives rebalances. Returns
         False (after rejoining) when fenced: the caller no longer owns
         the partition and must NOT treat its local tally as authoritative
-        (the new owner re-reads the markers and commits itself)."""
+        (the new owner re-reads the markers and commits itself).
+        ``offset`` (durable clusters) rides the commit: the partition's
+        committed segment-log offset, persisted with the coordinator's
+        group state so a coordinator restart recovers how far the
+        group's consumption provably reached."""
         with self._slock:
             gen = self.generation
-        resp = self.rpc({
+        payload = {
             "op": "drained",
             "group": self.group,
             "member": self.member_id,
             "generation": gen,
             "partition": partition,
-        })
+        }
+        if offset is not None:
+            payload["offset"] = int(offset)
+        resp = self.rpc(payload)
         if resp.get("fenced") or resp.get("unknown_group"):
             CLUSTER.fenced_op()
             self.join_group()

@@ -103,6 +103,30 @@ class TransportConfig:
     # partitions) and this member's stable id ("" = random per process)
     group: str = ""
     member_id: str = ""
+    # durable replay (ISSUE 8, server started with --durable_dir): open
+    # the queue's retained segment-log range NON-destructively instead
+    # of competing on the live queue. "" = live consumption; "begin" =
+    # earliest retained record; "resume" = this replay group's committed
+    # offset; a digit string = explicit offset. replay_group names the
+    # second consumer group whose committed offset the replay advances.
+    replay_from: str = ""
+    replay_group: str = "replay"
+
+
+@dataclasses.dataclass
+class DurabilityConfig:
+    """Queue-server segment-log knobs (ISSUE 8; ``queue_server.py
+    --durable_dir ...``). No reference counterpart — the reference's
+    queues die with the actor."""
+
+    durable_dir: Optional[str] = None  # None = memory-only (the default)
+    segment_bytes: int = 64 * 1024 * 1024  # pre-allocated segment size
+    retain_segments: int = 8  # consumed-history segments kept for replay
+    fsync: str = "batch"  # none | batch | always (see storage.log)
+    fsync_batch_n: int = 64  # appends per fsync under the batch policy
+    # RAM-resident records per queue before spill-to-disk (0 = the
+    # queue's own maxsize — spill only past the nominal depth)
+    ram_items: int = 0
 
 
 @dataclasses.dataclass

@@ -40,6 +40,8 @@ class DataReader:
         config: Optional[TransportConfig] = None,
         streaming: bool = False,
         stream_window: int = 32,
+        replay_from: Optional[str] = None,
+        replay_group: Optional[str] = None,
     ):
         """``streaming=True`` (TCP transports) subscribes the data
         connection to server-push delivery with a ``stream_window``-frame
@@ -49,13 +51,30 @@ class DataReader:
         disappears and the credit window bounds client memory like a
         prefetch depth. Delivery stays at-least-once: frames this reader
         consumed-but-not-yet-acked redeliver to another consumer on a
-        crash. Ignored (plain reads) on transports without streaming."""
+        crash. Ignored (plain reads) on transports without streaming.
+
+        ``replay_from`` (ISSUE 8, servers started with --durable_dir)
+        opens the queue's retained segment-log range NON-destructively
+        for a second consumer group instead of competing on the live
+        queue: ``"begin"`` starts at the earliest retained record,
+        ``"resume"`` at ``replay_group``'s committed offset, a digit
+        string at an explicit offset. Delivered records commit the
+        group's offset at the connection's implicit-ACK points, so a
+        crashed replay consumer reconnects at resume — duplicates
+        possible, loss never. Implies plain (pull) reads."""
         self.config = config or TransportConfig()
         self.address = address if address != "auto" else self.config.address
         self.queue_name = queue_name or self.config.queue_name
         self.namespace = namespace or self.config.namespace
         self.streaming = streaming
         self.stream_window = stream_window
+        self.replay_from = (
+            replay_from if replay_from is not None else
+            (self.config.replay_from or None)
+        )
+        self.replay_group = replay_group or self.config.replay_group
+        if self.replay_from is not None:
+            self.streaming = False  # replay is pull-mode by design
         self._queue = None
 
     # -- lifecycle (parity: data_reader.py:11-29,39-44) -------------------
@@ -76,6 +95,23 @@ class DataReader:
             self._queue = self._open()
         except RendezvousTimeout as e:
             raise DataReaderError(f"could not find queue {self.queue_name!r}: {e}") from e
+        if self.replay_from is not None:
+            if not hasattr(self._queue, "replay_open"):
+                raise DataReaderError(
+                    f"transport {self.address!r} does not support replay "
+                    f"(need a tcp:// or cluster:// durable queue server)"
+                )
+            start = (
+                self.replay_from
+                if self.replay_from in ("begin", "resume")
+                else int(self.replay_from)
+            )
+            try:
+                self._queue.replay_open(start, group=self.replay_group)
+            except TransportClosed as e:
+                raise DataReaderError(str(e)) from e
+            except RuntimeError as e:  # server refused: not durable
+                raise DataReaderError(str(e)) from e
         if self.streaming and hasattr(self._queue, "stream_open"):
             try:
                 self._queue.stream_open(self.stream_window)
@@ -227,6 +263,22 @@ def main(argv=None):
         "server blocks on this consumer's acks); bounds consumer-side "
         "memory like a prefetch depth",
     )
+    p.add_argument(
+        "--replay", default=None, metavar="from=<offset|begin|resume>",
+        help="durable servers (--durable_dir) only: read the queue's "
+        "RETAINED segment-log range non-destructively instead of "
+        "competing on the live queue — 'from=begin' replays the "
+        "earliest retained record (a new model revision re-reads "
+        "yesterday's run), 'from=resume' continues at --replay_group's "
+        "committed offset, 'from=<N>' starts at offset N. Live "
+        "consumers are undisturbed; progress commits per batch "
+        "(at-least-once on crash). Implies pull-mode reads",
+    )
+    p.add_argument(
+        "--replay_group", default="replay",
+        help="consumer-group name whose committed offset --replay "
+        "advances (a second group, independent of live consumption)",
+    )
     p.add_argument("--max_frames", type=int, default=None)
     p.add_argument("--quiet", action="store_true", help="suppress per-frame lines")
     p.add_argument("--log_level", default="INFO")
@@ -348,10 +400,19 @@ def main(argv=None):
 
     monitor = None
     try:
+        replay_from = None
+        if a.replay is not None:
+            replay_from = a.replay[5:] if a.replay.startswith("from=") else a.replay
+            if replay_from not in ("begin", "resume") and not replay_from.isdigit():
+                log.error(
+                    "--replay wants from=<offset|begin|resume>, got %r", a.replay
+                )
+                return 1
         with trace(a.profile_dir), DataReader(
             address=a.address, queue_name=a.queue_name, namespace=a.namespace,
             config=reader_config,
             streaming=a.stream, stream_window=a.stream_window,
+            replay_from=replay_from, replay_group=a.replay_group,
         ) as reader:
             if observe_dwell or a.trace_dir:
                 # depth in the heartbeat — over a DEDICATED handle, never

@@ -16,6 +16,7 @@ from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
     locks,
     names,
     resend,
+    segments,
     threads,
     wire,
 )

@@ -107,6 +107,12 @@ class FlightRecorder:
         with self._lock:
             return list(self._events)
 
+    def count_of(self, *kinds: str) -> int:
+        """Lifetime count across the named event kinds (counts survive
+        ring eviction — heartbeat summaries must not undercount)."""
+        with self._lock:
+            return sum(self._counts.get(k, 0) for k in kinds)
+
     # -- dump machinery ---------------------------------------------------
     def install(
         self,

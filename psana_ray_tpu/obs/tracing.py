@@ -468,7 +468,22 @@ def configure_from_args(args, process: str, queue=None) -> Optional[Tracer]:
 
 def obs_status_suffix() -> str:
     """One-call heartbeat suffix over the global tracer + flight recorder
-    (the consumer/sfx ``--status_interval`` lines append this)."""
+    (the consumer/sfx ``--status_interval`` lines append this). Durable-
+    storage breadcrumbs (ISSUE 8: segment rollover, spill entry/exit,
+    recovery scans, torn-tail repairs, replay opens/gaps) get their own
+    bracket whenever any fired in this process — empty otherwise, so
+    memory-only runs keep their exact pre-durability heartbeat lines."""
     from psana_ray_tpu.obs.flight import FLIGHT
 
-    return TRACER.status_suffix(FLIGHT)
+    out = TRACER.status_suffix(FLIGHT)
+    rolls = FLIGHT.count_of("segment_rollover")
+    spills = FLIGHT.count_of("spill_enter")
+    recoveries = FLIGHT.count_of("recovery_scan", "durable_reexpose")
+    torn = FLIGHT.count_of("torn_tail_repair")
+    replays = FLIGHT.count_of("replay_open", "replay_gap")
+    if rolls or spills or recoveries or torn or replays:
+        out += (
+            f" durable[roll={rolls} spill={spills} recover={recoveries}"
+            f" torn={torn} replay={replays}]"
+        )
+    return out

@@ -46,6 +46,58 @@ def main(argv=None):
         ),
     )
     p.add_argument(
+        "--durable_dir",
+        default=None,
+        metavar="DIR",
+        help=(
+            "back every queue with a recycled mmap'd segment log under "
+            "DIR (ISSUE 8): queued frames survive kill -9/restart (boot "
+            "re-exposes everything above the committed offset, repairing "
+            "a torn tail by CRC truncation), depth beyond RAM spills to "
+            "the log, consumers can --replay the retained range, and the "
+            "consumer-group coordinator state is persisted too. "
+            "Incompatible with --shm"
+        ),
+    )
+    from psana_ray_tpu.config import DurabilityConfig
+
+    # ONE source of truth for the durability knobs: the dataclass the
+    # library surface documents is also where the CLI defaults live
+    dur_defaults = DurabilityConfig()
+    p.add_argument(
+        "--segment_bytes", type=int, default=dur_defaults.segment_bytes,
+        help="pre-allocated size of one segment file (recycled, never "
+        "reallocated; must fit the largest record)",
+    )
+    p.add_argument(
+        "--retain_segments", type=int, default=dur_defaults.retain_segments,
+        help="fully-consumed segments kept for --replay before being "
+        "recycled; unconsumed records are NEVER recycled regardless",
+    )
+    p.add_argument(
+        "--fsync", choices=("none", "batch", "always"),
+        default=dur_defaults.fsync,
+        help="segment-log fsync policy: 'none' survives process death "
+        "(page cache) but a machine crash may lose the tail; 'batch' "
+        "fsyncs every --fsync_batch_n appends + on roll/commit; "
+        "'always' fsyncs per append (measured overhead in PERF_NOTES)",
+    )
+    p.add_argument(
+        "--fsync_batch_n", type=int, default=dur_defaults.fsync_batch_n,
+        help="appends per fsync under --fsync batch",
+    )
+    p.add_argument(
+        "--ram_items", type=int, default=dur_defaults.ram_items,
+        help="RAM-resident records per durable queue before spilling "
+        "delivery to log reads (0 = the queue's --queue_size)",
+    )
+    p.add_argument(
+        "--port_file", default=None,
+        help="write the bound port to this file once listening (harness "
+        "support: lets a supervisor/test start with --port 0 and learn "
+        "the port without parsing logs)",
+    )
+    p.add_argument(
         "--max_conns",
         type=int,
         default=0,
@@ -96,7 +148,53 @@ def main(argv=None):
     from psana_ray_tpu.transport.tcp import TcpQueueServer
 
     queue_factory = None
-    if a.shm:
+    group_store_path = None
+    if a.durable_dir and a.shm:
+        p.error("--durable_dir and --shm are mutually exclusive (the "
+                "segment log backs in-process queues; shm rings have "
+                "their own lifetime)")
+    if a.durable_dir:
+        import os
+
+        from psana_ray_tpu.storage import DurableRingBuffer, SegmentLog
+
+        os.makedirs(a.durable_dir, exist_ok=True)
+        group_store_path = os.path.join(a.durable_dir, "groups.json")
+
+        def _durable_backing(ns, name, maxsize):
+            # one log directory per named queue; the boot-time recovery
+            # scan runs inside SegmentLog.__init__
+            qdir = os.path.join(a.durable_dir, f"{ns}__{name}")
+            log = SegmentLog(
+                qdir,
+                segment_bytes=a.segment_bytes,
+                retain_segments=a.retain_segments,
+                fsync=a.fsync,
+                fsync_batch_n=a.fsync_batch_n,
+                name=f"{ns}/{name}",
+            )
+            q = DurableRingBuffer(
+                log, maxsize=maxsize, name=f"{ns}__{name}",
+                ram_items=a.ram_items or None,
+            )
+            depth = q.size()
+            if depth:
+                logger.info(
+                    "durable queue (%s, %s): recovered %d unconsumed "
+                    "record(s) from %s (committed offset %d%s)",
+                    ns, name, depth, qdir, log.committed(""),
+                    ", TORN TAIL repaired" if log.torn_tail_repaired else "",
+                )
+            return q
+
+        queue_factory = _durable_backing
+        backing = _durable_backing("default", "default", a.queue_size)
+        logger.info(
+            "backing queues: segment logs under %s (segment_bytes=%d, "
+            "retain=%d, fsync=%s)",
+            a.durable_dir, a.segment_bytes, a.retain_segments, a.fsync,
+        )
+    elif a.shm:
         from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
 
         def _shm_backing(name, maxsize):
@@ -123,7 +221,14 @@ def main(argv=None):
     server = TcpQueueServer(
         backing, host=a.host, port=a.port, maxsize=a.queue_size,
         queue_factory=queue_factory, max_conns=a.max_conns,
+        group_store_path=group_store_path,
     ).serve_background()
+    if a.port_file:
+        with open(a.port_file + ".tmp", "w") as f:
+            f.write(str(server.port))
+        import os as _os
+
+        _os.replace(a.port_file + ".tmp", a.port_file)  # atomic: no torn read
     logger.info(
         "queue server listening on %s:%d (size=%d%s) — clients use "
         "--address tcp://<host>:%d, or start N of these and point "
@@ -200,6 +305,10 @@ def main(argv=None):
         metrics_server.close()
     server.close_all()  # unblock ALL clients with TransportClosed (dead-queue parity)
     server.shutdown()
+    for q in server.all_queues():
+        log = getattr(q, "log", None)
+        if log is not None:  # durable backings: flush + unmap segments
+            log.close()
     return 0
 
 

@@ -1,0 +1,55 @@
+"""Durable segment-log storage under the queue server (ISSUE 8).
+
+The transports of PRs 1-7 are memory-only: a queue server restart takes
+its queue depth with it, and delivery is destructive — there is no
+"replay yesterday's run". This package adds the missing persistence
+layer with the same host-path discipline the datapath already follows
+(PAPERS.md: DALI-style pre-allocated recycled staging, tf.data's
+host-side robustness):
+
+- :mod:`psana_ray_tpu.storage.segment` — fixed-size, PRE-ALLOCATED,
+  RECYCLED mmap'd segment files with per-record CRC framing (a torn
+  tail from a crash is detected and truncated on the next boot, never
+  silently served);
+- :mod:`psana_ray_tpu.storage.log` — :class:`~psana_ray_tpu.storage.
+  log.SegmentLog`: an append-only offset-addressed record log over a
+  ring of segments, with bounded retention, a committed-offset store
+  per consumer group, and a crash-recovery scan;
+- :mod:`psana_ray_tpu.storage.durable` — :class:`~psana_ray_tpu.
+  storage.durable.DurableRingBuffer`: the log-backed RingBuffer
+  variant the queue server mounts under ``--durable_dir``. Appends go
+  to the log via the existing encode-into-slot scatter-gather plumbing
+  (one memcpy into the page cache, no intermediate bytes), reads serve
+  from RAM while depth fits and spill to log reads when it does not,
+  and consumer positions are committed offsets — crash-redelivery
+  across a server restart is "rewind to the last committed offset".
+- :mod:`psana_ray_tpu.storage.telemetry` — the ``durable`` obs source
+  (log depth, spill, recovery time, torn-tail repairs).
+
+At-least-once is preserved end to end: duplicates possible, holes
+never, loss never — including across kill -9 (page-cache writes
+survive process death; ``fsync`` policy ``none|batch|always`` chooses
+how much a MACHINE crash may lose).
+"""
+
+from psana_ray_tpu.storage.durable import DurableRingBuffer
+from psana_ray_tpu.storage.log import (
+    FSYNC_ALWAYS,
+    FSYNC_BATCH,
+    FSYNC_NONE,
+    REPLAY_BEGIN,
+    REPLAY_RESUME,
+    SegmentLog,
+)
+from psana_ray_tpu.storage.telemetry import DURABLE
+
+__all__ = [
+    "DurableRingBuffer",
+    "SegmentLog",
+    "DURABLE",
+    "FSYNC_NONE",
+    "FSYNC_BATCH",
+    "FSYNC_ALWAYS",
+    "REPLAY_BEGIN",
+    "REPLAY_RESUME",
+]

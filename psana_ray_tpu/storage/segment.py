@@ -1,0 +1,268 @@
+"""One mmap'd segment file: pre-allocated, CRC-framed, recycled.
+
+Segments follow the BufferPool discipline on disk (PAPERS.md, DALI's
+pre-allocated recycled staging): a segment is allocated ONCE at its
+fixed size (``ftruncate`` + ``mmap``), filled with framed records, and
+— once every record in it has fallen below the committed floor and out
+of the retention window — RESET and renamed to become the log's new
+tail instead of being deleted and reallocated. The hot append path is
+therefore one ``encode_into`` memcpy into already-mapped page cache:
+no per-frame file creation, no intermediate bytes object, no allocator
+traffic.
+
+Record framing (little-endian, 20-byte header)::
+
+    magic:u32  payload_len:u32  crc32:u32  offset:u64  payload bytes
+
+``payload`` is the same tagged codec payload the wire carries
+(``transport.codec``: tag byte + records wire format / pickle), so a
+logged record and a transmitted record are byte-compatible. The CRC
+covers the payload; a crash mid-append leaves either an all-zero
+header (clean end — pre-allocated segments start zeroed) or a record
+whose length/CRC/offset fails validation (a TORN TAIL, truncated by
+the recovery scan — see :meth:`Segment.scan`). Offsets are strictly
+increasing within and across segments, which also guards the scan
+against stale bytes from a recycled segment's previous life.
+
+A segment object must deterministically reach :meth:`close` or
+:meth:`reset` (recycle) on every path — enforced by the
+``segment-lifecycle`` lint checker the same way lease-lifecycle guards
+pool buffers.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import zlib
+from typing import List, Optional, Tuple
+
+from psana_ray_tpu.records import EndOfStream, FrameRecord, encode_into, encoded_size
+from psana_ray_tpu.transport.codec import TAG_PICKLE, TAG_RECORD
+
+_SEG_REC_MAGIC = 0x50525453  # "PRTS" — psana-ray-tpu segment record
+_REC_HEADER = struct.Struct("<IIIQ")
+REC_OVERHEAD = _REC_HEADER.size
+
+# zero block reused when scrubbing a recycled segment's previous records
+_ZEROS = bytes(1 << 20)
+
+
+def segment_filename(base_offset: int) -> str:
+    return f"seg-{base_offset:020d}.seg"
+
+
+def parse_base_offset(filename: str) -> Optional[int]:
+    if not (filename.startswith("seg-") and filename.endswith(".seg")):
+        return None
+    try:
+        return int(filename[4:-4])
+    except ValueError:
+        return None
+
+
+def record_nbytes(item) -> int:
+    """Framed size of ``item`` in a segment (header + tag + payload),
+    serializing only when the codec must (pickle fallback)."""
+    if isinstance(item, (FrameRecord, EndOfStream)):
+        return REC_OVERHEAD + 1 + encoded_size(item)
+    return REC_OVERHEAD + 1 + len(
+        pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+    )
+
+
+class Segment:
+    """One pre-allocated mmap'd segment. Create with :meth:`allocate` (new
+    or recycled file) or :meth:`open_existing` (recovery scan)."""
+
+    def __init__(self, path: str, f, mm: mmap.mmap, base_offset: int):
+        self.path = path
+        self._f = f
+        self._mm = mm
+        self._mv = memoryview(mm)
+        self.base_offset = base_offset
+        self.capacity = len(mm)
+        self.write_pos = 0
+        # (offset, file position) per record, append order — offsets are
+        # strictly increasing so readers bisect
+        self.index: List[Tuple[int, int]] = []
+        self.closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def allocate(cls, path: str, nbytes: int, base_offset: int) -> "Segment":
+        f = open(path, "a+b")
+        try:
+            f.truncate(nbytes)
+            mm = mmap.mmap(f.fileno(), nbytes)
+        except BaseException:
+            f.close()
+            raise
+        return cls(path, f, mm, base_offset)
+
+    @classmethod
+    def open_existing(cls, path: str, base_offset: int) -> "Segment":
+        f = open(path, "r+b")
+        try:
+            size = os.fstat(f.fileno()).st_size
+            mm = mmap.mmap(f.fileno(), size)
+        except BaseException:
+            f.close()
+            raise
+        return cls(path, f, mm, base_offset)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._mv.release()
+        self._mm.close()
+        self._f.close()
+
+    def retire(self, free_path: str) -> None:
+        """Move to the free list: scrub the written region (stale
+        records must never survive into the next life) and rename OUT of
+        the ``seg-*`` namespace, so a crash with free segments on disk
+        cannot poison the next boot's recovery scan (a stale ``seg-``
+        file would scan as valid history)."""
+        self._scrub(self.write_pos)
+        os.rename(self.path, free_path)
+        self.path = free_path
+        self.write_pos = 0
+        self.index = []
+
+    def reset(self, new_base_offset: int, new_path: str) -> None:
+        """Reuse a retired (already scrubbed) segment as the log's new
+        tail: rename into position and rewind."""
+        os.rename(self.path, new_path)
+        self.path = new_path
+        self.base_offset = new_base_offset
+        self.write_pos = 0
+        self.index = []
+
+    def _scrub(self, nbytes: int) -> None:
+        pos = 0
+        while pos < nbytes:
+            n = min(len(_ZEROS), nbytes - pos)
+            self._mv[pos : pos + n] = _ZEROS[:n]
+            pos += n
+
+    # -- append ------------------------------------------------------------
+    def remaining(self) -> int:
+        return self.capacity - self.write_pos
+
+    def append(self, offset: int, item) -> Optional[int]:
+        """Frame ``item`` at the write position; returns the record's
+        file position, or None when it does not fit (roll the log). The
+        payload lands via ONE ``encode_into`` memcpy for records (the
+        scatter-gather encode-into-slot path the shm ring uses); the
+        header is written AFTER the payload so a crash mid-memcpy leaves
+        an all-zero header, not a half-framed record."""
+        pos = self.write_pos
+        data_start = pos + REC_OVERHEAD
+        if isinstance(item, (FrameRecord, EndOfStream)):
+            need = 1 + encoded_size(item)
+            if data_start + need > self.capacity:
+                return None
+            self._mv[data_start : data_start + 1] = TAG_RECORD
+            n = encode_into(item, self._mv[data_start + 1 :])
+            payload_len = n + 1
+        else:
+            data = TAG_PICKLE + pickle.dumps(
+                item, protocol=pickle.HIGHEST_PROTOCOL
+            )
+            payload_len = len(data)
+            if data_start + payload_len > self.capacity:
+                return None
+            self._mv[data_start : data_start + payload_len] = data
+        crc = zlib.crc32(self._mv[data_start : data_start + payload_len])
+        _REC_HEADER.pack_into(
+            self._mv, pos, _SEG_REC_MAGIC, payload_len, crc, offset
+        )
+        self.write_pos = data_start + payload_len
+        self.index.append((offset, pos))
+        return pos
+
+    # -- read --------------------------------------------------------------
+    def payload_at(self, pos: int) -> memoryview:
+        """Zero-copy view of the record payload at ``pos``. The view is
+        TRANSIENT: decode (which copies the panels out) before any
+        operation that could reset or close this segment."""
+        magic, payload_len, _crc, _off = _REC_HEADER.unpack_from(self._mv, pos)
+        if magic != _SEG_REC_MAGIC:
+            raise ValueError(f"bad segment record magic {magic:#x} at {pos}")
+        start = pos + REC_OVERHEAD
+        return self._mv[start : start + payload_len]
+
+    def find(self, offset: int) -> Optional[int]:
+        """File position of the record with exactly ``offset``."""
+        import bisect
+
+        i = bisect.bisect_left(self.index, (offset, -1))
+        if i < len(self.index) and self.index[i][0] == offset:
+            return self.index[i][1]
+        return None
+
+    # -- recovery ----------------------------------------------------------
+    def scan(self, expect_from: int) -> Tuple[int, bool]:
+        """Rebuild the index from disk after a restart: walk records from
+        position 0, validating magic, bounds, CRC, and strictly
+        increasing offsets starting at ``expect_from`` (the previous
+        segment's last offset + 1 — also what stops the scan cold on a
+        recycled segment's stale bytes). Sets ``write_pos`` to the end
+        of the last valid record. Returns ``(last_valid_offset + 1,
+        torn)`` where ``torn`` reports a tail that had to be discarded
+        (nonzero bytes that failed validation — crash mid-append)."""
+        self.index = []
+        pos = 0
+        next_offset = expect_from
+        torn = False
+        while pos + REC_OVERHEAD <= self.capacity:
+            header = bytes(self._mv[pos : pos + REC_OVERHEAD])
+            if header == b"\0" * REC_OVERHEAD:
+                break  # clean end (pre-allocated segments start zeroed)
+            magic, payload_len, crc, offset = _REC_HEADER.unpack(header)
+            data_start = pos + REC_OVERHEAD
+            if (
+                magic != _SEG_REC_MAGIC
+                or payload_len == 0
+                or data_start + payload_len > self.capacity
+                or offset != next_offset
+                or zlib.crc32(self._mv[data_start : data_start + payload_len])
+                != crc
+            ):
+                torn = True
+                break
+            self.index.append((offset, pos))
+            next_offset = offset + 1
+            pos = data_start + payload_len
+        self.write_pos = pos
+        if torn:
+            # truncate the torn tail: scrub to capacity so the repaired
+            # region reads as a clean end on any later scan
+            cursor = pos
+            while cursor < self.capacity:
+                n = min(len(_ZEROS), self.capacity - cursor)
+                self._mv[cursor : cursor + n] = _ZEROS[:n]
+                cursor += n
+        return next_offset, torn
+
+    # -- durability --------------------------------------------------------
+    def sync(self) -> None:
+        self._mm.flush()
+
+    @property
+    def first_offset(self) -> Optional[int]:
+        return self.index[0][0] if self.index else None
+
+    @property
+    def last_offset(self) -> Optional[int]:
+        return self.index[-1][0] if self.index else None
+
+    def __repr__(self):
+        return (
+            f"<Segment {os.path.basename(self.path)} base={self.base_offset} "
+            f"records={len(self.index)} used={self.write_pos}/{self.capacity}>"
+        )

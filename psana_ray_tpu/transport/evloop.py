@@ -17,7 +17,7 @@ Design:
   non-blocking scatter-gather writes with EPOLLOUT-driven partial-send
   resumption. Thread count is independent of connection count; memory
   is O(connections x small struct).
-- Each connection is a :class:`_EvConn` state machine over all 17
+- Each connection is a :class:`_EvConn` state machine over all 19
   opcodes of the wire protocol (the opcode constants and
   part-gathering helpers are imported from ``transport.tcp``, so the
   wire format cannot fork). Reads land incrementally: control
@@ -75,12 +75,14 @@ from psana_ray_tpu.transport.codec import (
     encode_payload_parts as _encode_parts,
     payload_nbytes as _parts_nbytes,
 )
+from psana_ray_tpu.storage.log import COMMIT_DELIVERED
 from psana_ray_tpu.transport.tcp import (
     _MAX_PAYLOAD,
     _OP_ANCHOR,
     _OP_BYE,
     _OP_CLOSE,
     _OP_CLUSTER,
+    _OP_COMMIT,
     _OP_GET,
     _OP_GET_BATCH,
     _OP_GET_BATCH_WAIT,
@@ -89,6 +91,7 @@ from psana_ray_tpu.transport.tcp import (
     _OP_PUT_BATCH,
     _OP_PUT_SEQ,
     _OP_PUT_WAIT,
+    _OP_REPLAY,
     _OP_SIZE,
     _OP_STATS,
     _OP_STREAM,
@@ -247,10 +250,10 @@ class _EvConn:
 
     __slots__ = (
         "loop", "sock", "srv", "queue", "in_flight", "out", "out_bytes",
-        "closing", "closed", "stream", "pending", "op_gen",
+        "closing", "closed", "stream", "replay", "pending", "op_gen",
         "_hdr", "_hdr_mv", "_target", "_need", "_got", "_cb", "_lease",
         "_want_read", "_want_write", "_mask", "_sendmsg",
-        "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq",
+        "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq", "_r_from",
         "_open_ns", "_open_nm", "_open_buf",
     )
 
@@ -268,6 +271,9 @@ class _EvConn:
         self.closing = False  # flush remaining out bytes, then close
         self.closed = False
         self.stream: Optional[_StreamState] = None
+        # durable replay cursor ('R'): when set, this connection's reads
+        # serve the log non-destructively instead of popping the queue
+        self.replay = None
         self.pending: Optional[dict] = None  # deferred 'D'/'U'/'W' state
         self.op_gen = 0  # staleness guard for timer-heap entries
         self._hdr = bytearray(64)  # reused control-field scratch
@@ -285,6 +291,7 @@ class _EvConn:
         self._qb_items: List[Any] = []
         self._pw_wait_s = 0.0
         self._w_seq = 0
+        self._r_from = 0
         self._open_ns = ""
         self._open_nm = ""
         self._open_buf = b""
@@ -430,10 +437,23 @@ class _EvConn:
         self._set_interest(read=True)
 
     # -- opcode dispatch --------------------------------------------------
+    def _ack_in_flight(self) -> None:
+        """The implicit-ACK point: a durable queue advances (and
+        persists) its committed floor here; a replay cursor commits its
+        group's position. Memory-only queues no-op — delivery semantics
+        are unchanged where there is no log."""
+        if self.in_flight:
+            ack = getattr(self.queue, "ack_delivered", None)
+            if ack is not None:
+                ack(self.in_flight)
+        if self.replay is not None:
+            self.replay.commit()
+
     def _on_op(self) -> None:
         op = self._hdr[0]
         # previous response fully read by the peer (it can only send the
         # next request after reading the last response) — implicit ACK
+        self._ack_in_flight()
         self.in_flight = []
         if self.stream is not None:
             # a streamed connection carries only acks and BYE upstream
@@ -499,6 +519,23 @@ class _EvConn:
         (n,) = struct.unpack_from("<I", self._hdr)
         self._expect_payload(n, self._put_payload)
 
+    def _try_put(self, item):
+        """``queue.put`` with refusals surfaced as ANSWERS: a queue
+        exception beyond TransportClosed (e.g. a durable queue rejecting
+        a record larger than segment_bytes) must error THIS request —
+        killing the connection instead would make a windowed producer
+        resend the identical poison record on every reconnect until its
+        retries exhaust with a misleading connection-death error.
+        Returns True/False (enqueued / full), or None when a refusal
+        was already answered."""
+        try:
+            return self.queue.put(item)
+        except TransportClosed:
+            self._send_control(_ST_CLOSED)
+        except Exception:  # noqa: BLE001 — answer, don't kill the conn
+            self._send_control(_ST_ERR)
+        return None
+
     def _put_payload(self) -> None:
         item = self._take_item()
         if TRACER.enabled:
@@ -506,11 +543,8 @@ class _EvConn:
         if self.srv._draining:
             self._send_control(_ST_CLOSED)
         else:
-            try:
-                ok = self.queue.put(item)
-            except TransportClosed:
-                self._send_control(_ST_CLOSED)
-            else:
+            ok = self._try_put(item)
+            if ok is not None:
                 self._send_control(_ST_OK if ok else _ST_NO)
                 if ok:
                     self.loop.queue_touched(self.queue)
@@ -518,7 +552,11 @@ class _EvConn:
 
     def _op_get(self) -> None:
         try:
-            item = self.queue.get()
+            if self.replay is not None:
+                items = self.replay.next_batch(1)
+                item = items[0] if items else EMPTY
+            else:
+                item = self.queue.get()
         except TransportClosed:
             self._send_control(_ST_CLOSED)
         else:
@@ -539,7 +577,7 @@ class _EvConn:
     def _gb_hdr(self) -> None:
         (max_items,) = struct.unpack_from("<I", self._hdr)
         try:
-            items = self.queue.get_batch(min(max_items, 4096), timeout=0.0)
+            items = self._read_batch(min(max_items, 4096))
         except TransportClosed:
             self._send_control(_ST_CLOSED)
         else:
@@ -547,6 +585,13 @@ class _EvConn:
             if items:
                 self.loop.queue_touched(self.queue)
         self._await_op()
+
+    def _read_batch(self, max_items: int) -> List[Any]:
+        """Non-blocking read: the replay cursor when subscribed, the
+        live queue otherwise."""
+        if self.replay is not None:
+            return self.replay.next_batch(max_items)
+        return self.queue.get_batch(max_items, timeout=0.0)
 
     def _op_get_batch_wait(self) -> None:
         self._expect(8, self._gbw_hdr)
@@ -556,7 +601,7 @@ class _EvConn:
         max_items = min(max_items, 4096)
         wait_s = min(wait_ms / 1000.0, _SERVER_WAIT_CAP_S)
         try:
-            items = self.queue.get_batch(max_items, timeout=0.0)
+            items = self._read_batch(max_items)
         except TransportClosed:
             self._send_control(_ST_CLOSED)
             self._await_op()
@@ -588,10 +633,8 @@ class _EvConn:
             self._send_control(_ST_CLOSED)
             self._await_op()
             return
-        try:
-            ok = self.queue.put(item)
-        except TransportClosed:
-            self._send_control(_ST_CLOSED)
+        ok = self._try_put(item)
+        if ok is None:
             self._await_op()
             return
         if ok:
@@ -622,10 +665,8 @@ class _EvConn:
             self._send_control(_ST_CLOSED)
             self._await_op()
             return
-        try:
-            ok = self.queue.put(item)
-        except TransportClosed:
-            self._send_control(_ST_CLOSED)
+        ok = self._try_put(item)
+        if ok is None:
             self._await_op()
             return
         if ok:
@@ -672,15 +713,14 @@ class _EvConn:
             self._await_op()
             return
         accepted = 0
-        try:
-            for item in batch:
-                if not self.queue.put(item):
-                    break  # full: accepted prefix only (FIFO)
-                accepted += 1
-        except TransportClosed:
-            self._send_control(_ST_CLOSED)
-            self._await_op()
-            return
+        for item in batch:
+            ok = self._try_put(item)
+            if ok is None:  # refusal already answered ('X'/'E')
+                self._await_op()
+                return
+            if not ok:
+                break  # full: accepted prefix only (FIFO)
+            accepted += 1
         self.send_parts([_ST_OK + struct.pack("<I", accepted)])
         if accepted:
             self.loop.queue_touched(self.queue)
@@ -691,6 +731,11 @@ class _EvConn:
 
     def _stream_hdr(self) -> None:
         (window,) = struct.unpack_from("<I", self._hdr)
+        if self.replay is not None:
+            # replay is pull-mode by design: stream seqs and cursor
+            # offsets would need a second mapping for commit-on-ack —
+            # rejected loudly rather than committed wrongly
+            raise ConnectionError("stream subscribe on a replay connection")
         window = max(1, min(int(window), 4096))
         self.stream = _StreamState(window)
         STREAM.opened(window)
@@ -704,12 +749,17 @@ class _EvConn:
         if seq > st.acked:
             st.acked = seq
             STREAM.acked_msg()
-        pruned = 0
+        acked_items = []
         while st.unacked and st.unacked[0][0] <= st.acked:
-            st.unacked.popleft()  # credit returned: lease may free
-            pruned += 1
-        if pruned:
-            STREAM.pruned(pruned)
+            # credit returned: lease may free
+            acked_items.append(st.unacked.popleft()[1])
+        if acked_items:
+            STREAM.pruned(len(acked_items))
+            # the stream's explicit cumulative ack is a durable queue's
+            # commit point, same as the implicit next-opcode ACK
+            ack = getattr(self.queue, "ack_delivered", None)
+            if ack is not None:
+                ack(acked_items)
         self.loop.queue_touched(self.queue)  # new credits: pump may push
         self._await_op()
 
@@ -738,12 +788,14 @@ class _EvConn:
         st, self.stream = self.stream, None
         if st is None:
             return
-        pruned = 0
+        acked_items = []
         while st.unacked and st.unacked[0][0] <= st.acked:
-            st.unacked.popleft()
-            pruned += 1
-        if pruned:
-            STREAM.pruned(pruned)
+            acked_items.append(st.unacked.popleft()[1])
+        if acked_items:
+            STREAM.pruned(len(acked_items))
+            ack = getattr(self.queue, "ack_delivered", None)
+            if ack is not None:  # final cumulative ack commits too
+                ack(acked_items)
         lost = [item for (_s, item) in st.unacked]
         st.unacked.clear()
         if lost:
@@ -816,6 +868,59 @@ class _EvConn:
         self.send_parts([_ST_OK + struct.pack("<I", len(payload)), payload])
         self._await_op()
 
+    # -- durable log opcodes ('R'/'J', ISSUE 8) ---------------------------
+    def _op_replay(self) -> None:
+        self._expect(10, self._replay_hdr)
+
+    def _replay_hdr(self) -> None:
+        self._r_from, glen = struct.unpack_from("<QH", self._hdr)
+        self._open_buf = bytearray(glen)
+        self._arm(memoryview(self._open_buf), self._replay_finish)
+
+    def _replay_finish(self) -> None:
+        group = self._open_buf.decode() or "replay"
+        open_replay = getattr(self.queue, "open_replay", None)
+        if open_replay is None:  # memory-only queue: no retained range
+            self._send_control(_ST_NO)
+            self._await_op()
+            return
+        self.replay = open_replay(group, self._r_from)
+        self.send_parts([
+            _ST_OK
+            + struct.pack(
+                "<QQ", self.replay.position, self.replay.log.next_offset
+            )
+        ])
+        self._await_op()
+
+    def _op_commit(self) -> None:
+        self._expect(10, self._commit_hdr)
+
+    def _commit_hdr(self) -> None:
+        self._r_from, glen = struct.unpack_from("<QH", self._hdr)
+        self._open_buf = bytearray(glen)
+        self._arm(memoryview(self._open_buf), self._commit_finish)
+
+    def _commit_finish(self) -> None:
+        offset, group = self._r_from, self._open_buf.decode()
+        if self.replay is not None:
+            if offset == COMMIT_DELIVERED:
+                self.replay.commit()
+            else:
+                self.replay.commit(through=offset)
+            self._send_control(_ST_OK)
+            self._await_op()
+            return
+        commit = getattr(self.queue, "commit_offset", None)
+        if commit is None or not group or offset == COMMIT_DELIVERED:
+            # no log / no named group / the delivered sentinel without a
+            # replay cursor: nothing to commit against
+            self._send_control(_ST_NO)
+        else:
+            commit(offset, group)
+            self._send_control(_ST_OK)
+        self._await_op()
+
     def _op_open(self) -> None:
         self._expect(2, self._open_ns_len)
 
@@ -863,6 +968,8 @@ _OPS: Dict[int, str] = {
     _OP_STATS[0]: "_op_stats",
     _OP_ANCHOR[0]: "_op_anchor",
     _OP_CLUSTER[0]: "_op_cluster",
+    _OP_REPLAY[0]: "_op_replay",
+    _OP_COMMIT[0]: "_op_commit",
     _OP_BYE[0]: "_op_bye",
 }
 
@@ -997,6 +1104,12 @@ class EventLoop:
         # enqueueing now would stack a duplicate on top of that resend
         conn.pending = None
         conn._qb_items = []
+        if conn.replay is not None:
+            # cursor-based delivery: nothing to requeue — records the
+            # dead client read but never committed simply redeliver when
+            # its group re-opens at RESUME
+            conn.in_flight = []
+            conn.replay = None
         if requeue:
             if conn.in_flight:
                 self.requeue_items(conn.queue, conn.in_flight)
@@ -1124,9 +1237,7 @@ class EventLoop:
                 if kind == "D":
                     # one last non-blocking look, then the empty answer
                     try:
-                        items = conn.queue.get_batch(
-                            conn.pending["max_items"], timeout=0.0
-                        )
+                        items = conn._read_batch(conn.pending["max_items"])
                     except TransportClosed:
                         conn._send_control(_ST_CLOSED)
                         conn.unpark()
@@ -1162,11 +1273,15 @@ class EventLoop:
             # with the threaded server's single blocking get_batch).
             # size() alone is not a liveness probe — RingBuffer.size()
             # answers 0 on a CLOSED queue — so check closed explicitly
-            # (waiting streams must see 'X' promptly).
+            # (waiting streams must see 'X' promptly). Replay waiters
+            # read the LOG cursor, not the queue, so an empty live
+            # queue must not short-circuit past them.
             try:
                 if getattr(qs.queue, "closed", False):
                     raise _QueueClosedSignal
-                if not qs.queue.size():
+                if not qs.queue.size() and not any(
+                    c.replay is not None for c in gw if not c.closed
+                ):
                     return False
             except TransportClosed:
                 raise _QueueClosedSignal from None
@@ -1176,6 +1291,26 @@ class EventLoop:
             conn = gw[0]
             if conn.closed:
                 gw.popleft()
+                continue
+            if conn.replay is not None:
+                # replay waiter ('D' park): serve from the cursor
+                if conn.pending is None or conn.pending.get("kind") != "D":
+                    gw.popleft()
+                    continue
+                try:
+                    items = conn.replay.next_batch(conn.pending["max_items"])
+                except TransportClosed:
+                    raise _QueueClosedSignal from None
+                if not items:
+                    gw.rotate(-1)  # caught up: the timer answers empty
+                    continue
+                try:
+                    conn._respond_batch(items)
+                    gw.popleft()
+                    conn.unpark()
+                except (ConnectionError, OSError) as e:
+                    self.kill_conn(conn, e)
+                did = True
                 continue
             if conn.stream is not None:
                 want = min(conn.stream.budget(), _STREAM_POP_MAX)
@@ -1191,7 +1326,21 @@ class EventLoop:
                 items = qs.queue.get_batch(min(want, 4096), timeout=0.0)
             except TransportClosed:
                 raise _QueueClosedSignal from None
+            except Exception as e:  # noqa: BLE001 — a corrupt spill read
+                # must cost this waiter an error answer, not the loop
+                gw.popleft()
+                try:
+                    conn._send_control(_ST_ERR)
+                    if conn.stream is None:
+                        conn.unpark()
+                except (ConnectionError, OSError):
+                    self.kill_conn(conn, e)
+                did = True
+                continue
             if not items:
+                if any(c.replay is not None for c in gw if not c.closed):
+                    gw.rotate(-1)  # let replay waiters behind us run
+                    continue
                 break  # queue empty: every remaining get-waiter waits
             try:
                 if conn.stream is not None:
@@ -1221,6 +1370,19 @@ class EventLoop:
                 ok = qs.queue.put(conn.pending["item"])
             except TransportClosed:
                 raise _QueueClosedSignal from None
+            except Exception as e:  # noqa: BLE001 — e.g. a durable queue
+                # refusing an oversized record (ValueError from the
+                # segment log): answer THIS conn with a protocol error
+                # instead of letting the exception escape _pump_all and
+                # take the whole loop (and every connection) down
+                pw.popleft()
+                try:
+                    conn._send_control(_ST_ERR)
+                    conn.unpark()
+                except (ConnectionError, OSError):
+                    self.kill_conn(conn, e)
+                did = True
+                continue
             if not ok:
                 break  # still full: FIFO — nobody behind may jump the line
             pw.popleft()

@@ -75,6 +75,30 @@ class RingBuffer:
         self._last_put_t: float = -1.0  # monotonic; -1 = never  # guarded-by: _lock
         self._last_get_t: float = -1.0  # guarded-by: _lock
 
+    # -- storage hooks ----------------------------------------------------
+    # The log-backed variant (psana_ray_tpu.storage.durable.
+    # DurableRingBuffer) reuses ALL of this class's locking, condition,
+    # listener and lifecycle machinery by overriding just these two
+    # boxing hooks: ``_box`` maps an incoming item to its stored form
+    # (durable: append to the segment log, possibly spilling the RAM
+    # copy), ``_unbox`` maps the stored form back to the delivered item
+    # (durable: re-read a spilled record from the log). The base class
+    # stores items as themselves.
+    def _box(self, item: Any) -> Any:
+        # guarded-by-caller: _lock
+        return item
+
+    def _box_front(self, item: Any) -> Any:
+        """Boxing for HEAD re-insertion (the put_front recovery path);
+        durable reinstates the item's original log offset instead of
+        assigning a new one."""
+        # guarded-by-caller: _lock
+        return self._box(item)
+
+    def _unbox(self, stored: Any) -> Any:
+        # guarded-by-caller: _lock
+        return stored
+
     # -- reference-parity non-blocking surface ---------------------------
     def put(self, item: Any) -> bool:
         """Append if not full. Returns False when full (never drops).
@@ -85,7 +109,7 @@ class RingBuffer:
             if len(self._q) >= self.maxsize:
                 self._n_put_rejected += 1
                 return False
-            self._q.append(item)
+            self._q.append(self._box(item))
             self._note_put()
             self._not_empty.notify()
             return True
@@ -97,7 +121,10 @@ class RingBuffer:
             self._check_open()
             if not self._q:
                 return EMPTY
-            item = self._q.popleft()
+            # unbox BEFORE popping: a failing unbox (durable spill
+            # re-read) must leave the entry queued, not strand it
+            item = self._unbox(self._q[0])
+            self._q.popleft()
             self._note_get()
             self._not_full.notify()
             return item
@@ -115,7 +142,7 @@ class RingBuffer:
         allowed: it was counted when first enqueued."""
         with self._lock:
             self._check_open()
-            self._q.appendleft(item)
+            self._q.appendleft(self._box_front(item))
             if len(self._q) > self._high_water:
                 self._high_water = len(self._q)
             self._not_empty.notify()
@@ -159,7 +186,7 @@ class RingBuffer:
             self._check_accepting()
             if not ok:
                 return False
-            self._q.append(item)
+            self._q.append(self._box(item))
             self._note_put()
             self._not_empty.notify()
             return True
@@ -171,7 +198,8 @@ class RingBuffer:
             self._check_open()
             if not ok or not self._q:
                 return EMPTY
-            item = self._q.popleft()
+            item = self._unbox(self._q[0])  # peek-unbox-pop: see get()
+            self._q.popleft()
             self._note_get()
             self._not_full.notify()
             return item
@@ -187,10 +215,23 @@ class RingBuffer:
             if not ok:
                 return []
             n = min(max_items, len(self._q))
-            out = [self._q.popleft() for _ in range(n)]
-            if n:
-                self._note_get(n)
-            if n:
+            out: List[Any] = []
+            try:
+                for _ in range(n):
+                    # unbox BEFORE popping so a failure leaves the
+                    # failing entry queued...
+                    out.append(self._unbox(self._q[0]))
+                    self._q.popleft()
+            except BaseException:
+                # ...and REINSTATES the prefix already popped: without
+                # this, those entries would sit delivered-to-nobody (a
+                # durable queue would pin its committed floor under
+                # them until restart — an in-process hole)
+                for item in reversed(out):
+                    self._q.appendleft(self._box_front(item))
+                raise
+            if out:
+                self._note_get(len(out))
                 self._not_full.notify_all()
             return out
 

@@ -44,6 +44,22 @@ Wire protocol (all little-endian):
                   the server's :class:`psana_ray_tpu.cluster.coordinator.
                   GroupRegistry`); by convention clients send it to the
                   FIRST server of the cluster address list
+              'R' (replay-open) + from:u64 + group_len:u16 + group —
+                  durable queues only (ISSUE 8): switch this
+                  connection's READS to a non-destructive cursor over
+                  the queue's retained segment-log range for the named
+                  consumer group (live consumers undisturbed). ``from``
+                  is an offset or a sentinel (u64 max = begin/earliest
+                  retained, u64 max-1 = resume at the group's committed
+                  offset). Subsequent G/B/D serve from the cursor;
+                  delivered records are committed for the group at the
+                  connection's implicit-ACK points, so crash-redelivery
+                  is re-open at resume
+              'J' (commit-offset) + offset:u64 + group_len:u16 + group —
+                  durable queues only: persist the group's committed
+                  offset (offset u64 max = "everything delivered to this
+                  connection's replay cursor so far"); '0' when the
+                  bound queue has no log
               'F' (bye) — no response; acks the last delivery and ends
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
@@ -54,6 +70,8 @@ Wire protocol (all little-endian):
               + [T ok] len:u32 + JSON stats object
               + [A ok] wall:f64 + mono:f64
               + [N ok] len:u32 + JSON group-state object
+              + [R ok] start:u64 + end:u64 (resolved cursor start and
+                the log tail at open time; the cursor follows the tail)
     stream push (server -> client, after 'M'):
               status:u8 ('1') + seq:u64 + len:u32 + payload per frame;
               'X' when the bound queue closes (the stream is over)
@@ -144,7 +162,7 @@ the popped item(s).
 
 Server architecture (ISSUE 6): the server IS a single selectors/epoll
 readiness loop (:mod:`psana_ray_tpu.transport.evloop`) driving a
-per-connection state machine over all 17 opcodes — memory O(connections
+per-connection state machine over all 19 opcodes — memory O(connections
 x small struct), thread count independent of connection count, blocking
 waits ('W'/'U'/'D', stream credit stalls) held as timer/deferred-
 callback state instead of parked threads. The legacy thread-per-
@@ -201,6 +219,8 @@ _OP_OPEN = b"O"
 _OP_STATS = b"T"
 _OP_ANCHOR = b"A"
 _OP_CLUSTER = b"N"
+_OP_REPLAY = b"R"
+_OP_COMMIT = b"J"
 _OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
@@ -521,7 +541,7 @@ class TcpQueueServer:
     docstring. Start with ``serve_background()``.
 
     The serving architecture is one epoll readiness loop with
-    per-connection state machines for all 17 opcodes, blocking waits as
+    per-connection state machines for all 19 opcodes, blocking waits as
     timer/deferred state (:mod:`psana_ray_tpu.transport.evloop`) —
     scales to thousands of streamed subscribers with O(1) threads. The
     legacy thread-per-connection mode was removed (ISSUE 7); ``mode``
@@ -544,6 +564,7 @@ class TcpQueueServer:
         pool: Optional[BufferPool] = None,
         mode: Optional[str] = None,
         max_conns: int = 0,
+        group_store_path: Optional[str] = None,
     ):
         self.queue = queue if queue is not None else RingBuffer(maxsize)
         self._maxsize = maxsize
@@ -574,10 +595,13 @@ class TcpQueueServer:
         self.max_conns = int(max_conns)
         self._loop = None  # the EventLoop driving this server
         # consumer-group coordinator state (cluster 'N' RPC). Imported
-        # lazily: psana_ray_tpu.cluster's client half imports this module
+        # lazily: psana_ray_tpu.cluster's client half imports this module.
+        # With a store path (queue_server --durable_dir) the control
+        # state snapshots to disk and a coordinator restart recovers
+        # groups instead of emptying them (ISSUE 8).
         from psana_ray_tpu.cluster.coordinator import GroupRegistry
 
-        self.groups = GroupRegistry()
+        self.groups = GroupRegistry(store_path=group_store_path)
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
         """Get-or-create the named queue (the OPEN opcode server-side;
@@ -768,6 +792,10 @@ class TcpQueueClient:
         self._reconnect_tries = reconnect_tries
         self._reconnect_base_s = reconnect_base_s
         self._binding: Optional[tuple] = None  # (ns, name, maxsize) to replay
+        # durable replay subscription to re-establish on reconnect:
+        # (position sentinel, group) — always RESUME, so the server's
+        # committed offset carries the position across drops
+        self._replay_args: Optional[tuple] = None
         self._lock = threading.Lock()
         # streaming / windowed-put state — initialized BEFORE the dial so
         # _reconnect (reachable from __init__) can consult it safely.
@@ -863,6 +891,37 @@ class TcpQueueClient:
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._binding is not None:
                     self._open_raw(*self._binding)
+                if self._replay_args is not None:
+                    # re-open the replay cursor at the group's committed
+                    # offset: everything unconfirmed redelivers (dupes
+                    # possible, holes never)
+                    pos, rg = self._replay_args
+                    g = rg.encode()
+                    self._sock.sendall(
+                        _OP_REPLAY + struct.pack("<QH", pos, len(g)) + g
+                    )
+                    if self._status() == _ST_OK:
+                        _recv_exact(self._sock, 16)
+                    else:
+                        # the server came back WITHOUT a log for this
+                        # queue: continuing would silently turn this
+                        # non-destructive replay reader into a live
+                        # consumer (popping frames live consumers own).
+                        # Fail the transport loudly instead.
+                        FLIGHT.record(
+                            "replay_resubscribe_refused",
+                            host=self.host, port=self.port,
+                        )
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        raise TransportClosed(
+                            f"replay re-subscription refused by "
+                            f"{self.host}:{self.port} — the restarted "
+                            f"server has no segment log for this queue; "
+                            f"refusing to degrade into a live consumer"
+                        )
                 # windowed-put resend invariant: the entire unacked tail
                 # goes out FIRST, in sequence order, before any new
                 # request touches the fresh connection — the server may
@@ -1223,6 +1282,89 @@ class TcpQueueClient:
 
         if deadline is None:
             deadline = time.monotonic() + self.PROBE_DEADLINE_S
+        with self._lock:
+            return self._retrying(_do, deadline)
+
+    # -- durable log surface (opcodes 'R'/'J', ISSUE 8) -------------------
+    def replay_open(self, from_offset=None, group: str = "replay") -> dict:
+        """Switch this connection's reads to a NON-DESTRUCTIVE replay
+        cursor over the bound queue's retained segment-log range for
+        ``group`` (durable queues only — raises RuntimeError otherwise).
+        ``from_offset``: ``None``/``"resume"`` resumes at the group's
+        committed offset, ``"begin"`` starts at the earliest retained
+        record, an int is an explicit offset. Live consumers are
+        undisturbed. Delivered records are committed for the group at
+        this connection's implicit-ACK points, so a crashed replay
+        consumer re-opens with ``resume`` and loses nothing (duplicates
+        possible since the last commit). Returns ``{"start", "end"}``.
+        On reconnect the subscription replays itself at ``resume``."""
+        from psana_ray_tpu.storage.log import REPLAY_BEGIN, REPLAY_RESUME
+
+        if self._stream is not None:
+            # a streamed connection carries only pushes and acks; 'R'
+            # on it is a protocol error server-side, and a side-channel
+            # replay would NOT redirect THIS connection's reads — there
+            # is no sane silent fallback, so refuse loudly
+            raise RuntimeError(
+                "replay_open on a streamed connection — replay is "
+                "pull-mode; use a dedicated (non-streamed) client"
+            )
+        if from_offset is None or from_offset == "resume":
+            pos = REPLAY_RESUME
+        elif from_offset == "begin":
+            pos = REPLAY_BEGIN
+        else:
+            pos = int(from_offset)
+        g = group.encode()
+
+        def _do():
+            self._sock.sendall(
+                _OP_REPLAY + struct.pack("<QH", pos, len(g)) + g
+            )
+            st = self._status()
+            if st != _ST_OK:
+                raise RuntimeError(
+                    f"replay refused: queue {self._binding or 'default'} "
+                    f"on {self.host}:{self.port} has no segment log "
+                    f"(start the server with --durable_dir)"
+                )
+            start, end = struct.unpack("<QQ", _recv_exact(self._sock, 16))
+            return {"start": start, "end": end}
+
+        with self._lock:
+            out = self._retrying(_do)
+            # reconnects re-subscribe at the group's committed offset —
+            # the server-side commit state carries the position
+            self._replay_args = (REPLAY_RESUME, group)
+        # client-side breadcrumb: the consumer process's own flight ring
+        # (and its --status_interval `durable[...]` bracket) must show
+        # the replay even when the server runs elsewhere
+        FLIGHT.record(
+            "replay_open", host=self.host, port=self.port, group=group,
+            start=out["start"], end=out["end"],
+        )
+        return out
+
+    def commit_offset(
+        self, offset=None, group: str = "", deadline: Optional[float] = None
+    ) -> bool:
+        """Persist a committed offset for ``group`` on the bound durable
+        queue ('J'). ``offset=None`` commits everything DELIVERED to
+        this connection's replay cursor so far (the explicit form of the
+        implicit ack). False when the queue has no log."""
+        from psana_ray_tpu.storage.log import COMMIT_DELIVERED
+
+        if self._stream is not None:
+            return self._side_channel().commit_offset(offset, group, deadline)
+        pos = COMMIT_DELIVERED if offset is None else int(offset)
+        g = group.encode()
+
+        def _do():
+            self._sock.sendall(
+                _OP_COMMIT + struct.pack("<QH", pos, len(g)) + g
+            )
+            return self._status() == _ST_OK
+
         with self._lock:
             return self._retrying(_do, deadline)
 

@@ -1,0 +1,284 @@
+"""Reusable fault-injection TCP proxies for transport/durability tests.
+
+Grown out of the ad-hoc delay-line proxy test_tcp_stream.py carried
+since ISSUE 5 (now imported from here): a recovery test should INJECT
+its failure — kill the wire at an exact byte, tear a write in half,
+stall a direction — instead of reaching into server internals or
+killing sockets it happens to hold. Both proxies listen on an ephemeral
+local port and forward to a destination.
+
+:class:`DelayProxy`
+    Fixed one-way latency, unlimited bandwidth (per-direction delay
+    lines with chunk coalescing) — models RTT, not throughput.
+
+:class:`FaultProxy`
+    Byte-counting fault injector. Faults are armed per direction
+    (``"up"`` = client->server, ``"down"`` = server->client):
+
+    - ``kill_at(direction, nbytes)`` — forward exactly ``nbytes`` more,
+      then sever BOTH sides of every connection (a crash mid-message:
+      the peer sees a clean-cut byte stream, exactly what a kill -9 of
+      the remote produces on the wire);
+    - ``torn_write_at(direction, nbytes, keep)`` — at the trigger,
+      forward only ``keep`` bytes of the in-flight chunk, then sever
+      (a torn write: the receiver holds a half-record);
+    - ``stall_at(direction, nbytes, stall_s)`` — pause forwarding that
+      direction for ``stall_s`` (connections stay up: models a wedged
+      peer / network brownout, the stall-detector's jurisdiction);
+    - ``kill_now()`` — sever everything immediately.
+
+    Counting is cumulative across connections per direction, so "kill
+    after the 3rd frame" is ``kill_at("up", 3 * frame_wire_bytes)``
+    regardless of reconnects. One fault per direction at a time; re-arm
+    after it fires (``fired`` tells you it did).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import deque
+
+
+class DelayProxy:
+    """TCP proxy adding a fixed one-way latency WITHOUT limiting
+    bandwidth: each received chunk enters a per-direction delay line and
+    is released ``delay_s`` later (a sleep-per-chunk pump would serialize
+    chunks and model bandwidth, not latency)."""
+
+    def __init__(self, dst_host: str, dst_port: int, delay_s: float):
+        self.delay_s = delay_s
+        self._dst = (dst_host, dst_port)
+        self._stop = threading.Event()
+        self._socks = []
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.port = self._lsock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        self._lsock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                dst = socket.create_connection(self._dst, timeout=5.0)
+            except OSError:
+                conn.close()
+                continue
+            for s in (conn, dst):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks += [conn, dst]
+            self._pipe(conn, dst)
+            self._pipe(dst, conn)
+
+    def _pipe(self, src, dst):
+        line = deque()  # (deliver_at, chunk)
+        cond = threading.Condition()
+        eof = [False]
+
+        def rx():
+            try:
+                while not self._stop.is_set():
+                    data = src.recv(1 << 20)  # big chunks: the proxy must
+                    # model latency, not become the bandwidth bottleneck
+                    if not data:
+                        break
+                    with cond:
+                        line.append((time.monotonic() + self.delay_s, data))
+                        cond.notify()
+            except OSError:
+                pass
+            with cond:
+                eof[0] = True
+                cond.notify()
+
+        def tx():
+            try:
+                while True:
+                    with cond:
+                        while not line and not eof[0]:
+                            if self._stop.is_set():
+                                return
+                            cond.wait(timeout=0.2)
+                        if not line:
+                            break
+                        at, data = line.popleft()
+                        lag = at - time.monotonic()
+                        if lag <= 0:
+                            # coalesce every already-ripe chunk into one
+                            # send: per-chunk wakeups would quantize the
+                            # relay to the scheduler tick and turn the
+                            # latency model into a bandwidth bottleneck
+                            ripe = [data]
+                            now = time.monotonic()
+                            while line and line[0][0] <= now:
+                                ripe.append(line.popleft()[1])
+                            data = b"".join(ripe) if len(ripe) > 1 else data
+                            lag = 0.0
+                    if lag > 0:
+                        time.sleep(lag)
+                    dst.sendall(data)
+            except OSError:
+                pass
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+        threading.Thread(target=rx, daemon=True).start()
+        threading.Thread(target=tx, daemon=True).start()
+
+    def close(self):
+        self._stop.set()
+        for s in [self._lsock, *self._socks]:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class _Fault:
+    __slots__ = ("kind", "at_bytes", "keep", "stall_s", "fired")
+
+    def __init__(self, kind, at_bytes, keep=0, stall_s=0.0):
+        self.kind = kind  # "kill" | "torn" | "stall"
+        self.at_bytes = at_bytes
+        self.keep = keep
+        self.stall_s = stall_s
+        self.fired = False
+
+
+class FaultProxy:
+    """Byte-counting fault injector — see the module docstring."""
+
+    def __init__(self, dst_host: str, dst_port: int):
+        self._dst = (dst_host, dst_port)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._socks = []  # guarded-by: _lock
+        self._bytes = {"up": 0, "down": 0}  # guarded-by: _lock
+        self._faults = {"up": None, "down": None}  # guarded-by: _lock
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.port = self._lsock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    # -- fault arming ------------------------------------------------------
+    def kill_at(self, direction: str, nbytes: int) -> "_Fault":
+        return self._arm(direction, _Fault("kill", nbytes))
+
+    def torn_write_at(self, direction: str, nbytes: int, keep: int) -> "_Fault":
+        return self._arm(direction, _Fault("torn", nbytes, keep=keep))
+
+    def stall_at(self, direction: str, nbytes: int, stall_s: float) -> "_Fault":
+        return self._arm(direction, _Fault("stall", nbytes, stall_s=stall_s))
+
+    def _arm(self, direction: str, fault: _Fault) -> _Fault:
+        if direction not in ("up", "down"):
+            raise ValueError(f"direction must be up|down, got {direction!r}")
+        with self._lock:
+            self._faults[direction] = fault
+        return fault
+
+    def bytes_forwarded(self, direction: str) -> int:
+        with self._lock:
+            return self._bytes[direction]
+
+    def kill_now(self) -> None:
+        """Sever every proxied connection immediately (both sides)."""
+        with self._lock:
+            socks, self._socks = self._socks, []
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- plumbing ----------------------------------------------------------
+    def _accept(self):
+        self._lsock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                dst = socket.create_connection(self._dst, timeout=5.0)
+            except OSError:
+                conn.close()
+                continue
+            for s in (conn, dst):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._socks += [conn, dst]
+            threading.Thread(
+                target=self._pump, args=(conn, dst, "up"), daemon=True
+            ).start()
+            threading.Thread(
+                target=self._pump, args=(dst, conn, "down"), daemon=True
+            ).start()
+
+    def _pump(self, src, dst, direction: str):
+        try:
+            while not self._stop.is_set():
+                data = src.recv(1 << 16)
+                if not data:
+                    break
+                send = data
+                fire = None
+                stall = 0.0
+                with self._lock:
+                    fault = self._faults[direction]
+                    counted = self._bytes[direction]
+                    if fault is not None and not fault.fired and (
+                        counted + len(data) >= fault.at_bytes
+                    ):
+                        fault.fired = True
+                        if fault.kind == "kill":
+                            send = data[: max(0, fault.at_bytes - counted)]
+                            fire = "kill"
+                        elif fault.kind == "torn":
+                            cut = max(0, fault.at_bytes - counted)
+                            send = data[: cut + fault.keep]
+                            fire = "kill"  # a torn write severs after it
+                        else:  # stall: forward intact, then pause
+                            stall = fault.stall_s
+                    self._bytes[direction] += len(send)
+                if send:
+                    dst.sendall(send)
+                if fire == "kill":
+                    self.kill_now()
+                    return
+                if stall:
+                    time.sleep(stall)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        self.kill_now()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass

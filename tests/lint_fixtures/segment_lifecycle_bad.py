@@ -1,0 +1,28 @@
+"""BAD: segment/mmap acquisitions that leak their mapping — an assigned
+segment with no close/retire/reset on any path, a dropped acquisition,
+and a raw mmap that never reaches an owner."""
+
+import mmap
+
+from psana_ray_tpu.storage.segment import Segment
+
+
+def scan_orphans(path):
+    seg = Segment.open_existing(path, 0)
+    n, torn = seg.scan(0)  # mapping stranded: nothing ever closes it
+    return n, torn
+
+
+def probe(path):
+    Segment.allocate(path, 1 << 20, 0)  # result dropped on the floor
+
+
+def peek_header(f):
+    mm = mmap.mmap(f.fileno(), 4096)
+    first = mm[0]
+    return first  # the BYTE escapes, the mapping leaks
+
+
+def roll_without_tracking(log):
+    seg = log._new_segment(log.next_offset)
+    seg.append(log.next_offset, b"x")  # never appended to the ring

@@ -1,0 +1,45 @@
+"""GOOD: the sanctioned segment-ownership patterns — try/finally close,
+exception-path close with ownership transfer by return, hand-off to the
+tracked ring, an owning constructor, and a context-managed mapping."""
+
+import mmap
+
+from psana_ray_tpu.storage.segment import Segment
+
+
+def scan_once(path):
+    seg = Segment.open_existing(path, 0)
+    try:
+        return seg.scan(0)
+    finally:
+        seg.close()
+
+
+def open_mapped(path, f):
+    mm = mmap.mmap(f.fileno(), 1 << 20)
+    try:
+        return Segment(path, f, mm, 0)  # the constructor takes ownership
+    except BaseException:
+        mm.close()
+        raise
+
+
+def fresh_tail(path):
+    return Segment.allocate(path, 1 << 20, 0)  # caller owns
+
+
+def roll(log):
+    seg = log._new_segment(log.next_offset)
+    log._segments.append(seg)  # the ring owns (closed by log.close)
+    return seg
+
+
+def retire_oldest(log, free_path):
+    seg = log._segments.pop(0)
+    seg.retire(free_path)
+    log._free.append(seg)
+
+
+def peek_header(f):
+    with mmap.mmap(f.fileno(), 4096) as mm:
+        return mm[0]

@@ -21,6 +21,8 @@ from psana_ray_tpu.transport import EMPTY, TransportClosed
 from psana_ray_tpu.transport.ring import RingBuffer
 from psana_ray_tpu.transport.tcp import STREAM, TcpQueueClient, TcpQueueServer
 
+from faultproxy import DelayProxy
+
 
 def _rec(idx, shape=(1, 8, 8), rank=0):
     return FrameRecord(rank, idx, np.full(shape, float(idx), np.float32), 1.0)
@@ -518,108 +520,9 @@ class TestStreamingDataReader:
             srv.shutdown()
 
 
-class DelayProxy:
-    """TCP proxy adding a fixed one-way latency WITHOUT limiting
-    bandwidth: each received chunk enters a per-direction delay line and
-    is released ``delay_s`` later (a sleep-per-chunk pump would serialize
-    chunks and model bandwidth, not latency)."""
-
-    def __init__(self, dst_host: str, dst_port: int, delay_s: float):
-        self.delay_s = delay_s
-        self._dst = (dst_host, dst_port)
-        self._stop = threading.Event()
-        self._socks = []
-        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind(("127.0.0.1", 0))
-        self._lsock.listen(16)
-        self.port = self._lsock.getsockname()[1]
-        threading.Thread(target=self._accept, daemon=True).start()
-
-    def _accept(self):
-        self._lsock.settimeout(0.2)
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._lsock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            try:
-                dst = socket.create_connection(self._dst, timeout=5.0)
-            except OSError:
-                conn.close()
-                continue
-            for s in (conn, dst):
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks += [conn, dst]
-            self._pipe(conn, dst)
-            self._pipe(dst, conn)
-
-    def _pipe(self, src, dst):
-        line = deque()  # (deliver_at, chunk)
-        cond = threading.Condition()
-        eof = [False]
-
-        def rx():
-            try:
-                while not self._stop.is_set():
-                    data = src.recv(1 << 20)  # big chunks: the proxy must
-                    # model latency, not become the bandwidth bottleneck
-                    if not data:
-                        break
-                    with cond:
-                        line.append((time.monotonic() + self.delay_s, data))
-                        cond.notify()
-            except OSError:
-                pass
-            with cond:
-                eof[0] = True
-                cond.notify()
-
-        def tx():
-            try:
-                while True:
-                    with cond:
-                        while not line and not eof[0]:
-                            if self._stop.is_set():
-                                return
-                            cond.wait(timeout=0.2)
-                        if not line:
-                            break
-                        at, data = line.popleft()
-                        lag = at - time.monotonic()
-                        if lag <= 0:
-                            # coalesce every already-ripe chunk into one
-                            # send: per-chunk wakeups would quantize the
-                            # relay to the scheduler tick and turn the
-                            # latency model into a bandwidth bottleneck
-                            ripe = [data]
-                            now = time.monotonic()
-                            while line and line[0][0] <= now:
-                                ripe.append(line.popleft()[1])
-                            data = b"".join(ripe) if len(ripe) > 1 else data
-                            lag = 0.0
-                    if lag > 0:
-                        time.sleep(lag)
-                    dst.sendall(data)
-            except OSError:
-                pass
-            try:
-                dst.shutdown(socket.SHUT_WR)
-            except OSError:
-                pass
-
-        threading.Thread(target=rx, daemon=True).start()
-        threading.Thread(target=tx, daemon=True).start()
-
-    def close(self):
-        self._stop.set()
-        for s in [self._lsock, *self._socks]:
-            try:
-                s.close()
-            except OSError:
-                pass
+# DelayProxy moved to tests/faultproxy.py (ISSUE 8): the delay-line
+# proxy grew into the reusable fault-injection harness (kill-at-byte,
+# torn-write, stall) that drives the durability recovery tests too.
 
 
 class _CountingSock:

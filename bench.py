@@ -841,6 +841,16 @@ def main():
         lambda: _bench_host_datapath(extras, smoke),
     )
 
+    # ---------------- wire compression: bandwidth-bound links ------------
+    # device-free (ISSUE 9): negotiated codec A/B through a ~50 MB/s
+    # token-bucket throttled proxy + per-codec ratio / MB/s + the
+    # copies/allocs pins on the compressed path
+    run_section(
+        wd,
+        "wire-compression",
+        lambda: _bench_wire_compression(extras, smoke),
+    )
+
     # ---------------- connection scaling: C10K event-loop server ---------
     # device-free: 16/128/1024 streamed subscribers, event-loop vs
     # thread-per-connection A/B (ISSUE 6)
@@ -2331,6 +2341,305 @@ def _bench_host_datapath(extras, smoke=False):
         f"flight, {occupancy['acks']} acks, "
         f"{occupancy['redelivered']} redelivered)"
     )
+
+
+def _detector_like_frames(shape, seed, n=4):
+    """Raw-stream epix-like u16 content: smooth per-pixel pedestal
+    (fixed-pattern), sigma~3 gaussian readout noise, sparse photon
+    peaks — the content class detector wire compression exists for
+    (uniform noise would flatter nobody; real raw frames are not
+    uniform noise)."""
+    rng = np.random.default_rng(seed)
+    ped = 2000 + 200 * np.sin(
+        np.linspace(0, 20, int(np.prod(shape)))
+    ).reshape(shape)
+    out = []
+    for _ in range(n):
+        f = (ped + rng.normal(0, 3, shape)).clip(0, 65535).astype(np.uint16)
+        hits = rng.random(shape) < 1e-4
+        f[hits] += rng.integers(500, 3000, int(hits.sum())).astype(np.uint16)
+        out.append(f)
+    return out
+
+
+def _wire_compression_producer(port, codec_name, shape, total, seed):
+    """Subprocess body for the wire-compression relay rows: a REAL
+    producer process, because compression burns a core the relay and
+    consumer must not share — the cross-process topology every
+    deployment has (in-process threads would serialize the codec
+    stages on the GIL and measure Python, not the transport)."""
+    import time as _time
+
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+    pool16 = _detector_like_frames(tuple(shape), seed)
+    client = TcpQueueClient(
+        "127.0.0.1", port,
+        codec=None if codec_name == "none" else codec_name,
+    )
+    for i in range(total):
+        while not client.put_pipelined(
+            FrameRecord(0, i, pool16[i % 4], 9.5),
+            deadline=_time.monotonic() + 2.0,
+        ):
+            pass
+    client.flush_puts()
+    client.put_wait(EndOfStream(total_events=total), timeout=120.0)
+    client.disconnect()
+
+
+def _bench_wire_compression(extras, smoke=False):
+    """Wire compression accounting (ISSUE 9, no device): the bandwidth
+    wall PERF_NOTES' arithmetic predicts (10x on 4.33 MB epix u16
+    frames needs >=3.9 GB/s links; this env's tunnel measures 30-50
+    MB/s) attacked with the negotiated per-connection codec layer.
+
+    - ``wire_compression_codecs``: per registered codec, the measured
+      compression ratio and compress/decompress MB/s on DETECTOR-LIKE
+      u16 frames (per-pixel pedestal fixed-pattern + sigma~3 readout
+      noise + sparse photon peaks — the content class the
+      shuffle+delta/RLE/bit-pack codec exists for; uniform noise would
+      flatter nobody and real raw frames are not uniform noise);
+    - ``wire_compression_relay``: A/B fps of the full producer ->
+      queue-server -> streamed-consumer relay through a token-bucket
+      BANDWIDTH-throttled proxy (tests/faultproxy.ThrottleProxy at
+      ~50 MB/s, both directions capped like a real tunnel) —
+      uncompressed vs each codec, with the measured speedup and the
+      proxy's actual wire byte counts;
+    - the zero-copy pins on the COMPRESSED path: copies/frame == 1.00
+      (the batch-arena memcpy; codec transforms stage through pool
+      leases, not fresh allocations) and steady-state pool churn
+      allocs/frame == 0, measured on an instrumented private pool;
+    - ``wire_compression_loopback_fps``: the same harness on raw
+      loopback WITHOUT negotiation — parity with the host-datapath
+      streaming row shows the default path is untouched.
+
+    Acceptance (ISSUE 9): compressed relay >= 2x uncompressed fps
+    through the ~50 MB/s proxy; recorded, not assumed.
+    """
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests")
+    )
+    from faultproxy import ThrottleProxy
+
+    from psana_ray_tpu.infeed.batcher import batches_from_queue
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport import RingBuffer
+    from psana_ray_tpu.transport.codec import (
+        CODEC_STATS,
+        available_codecs,
+        compress_encoded_parts,
+        encode_payload_parts,
+        get_codec,
+    )
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+    from psana_ray_tpu.utils.bufpool import BufferPool, WIRE
+
+    shape = (2, 32, 32) if smoke else (16, 352, 384)  # epix10k2M u16
+    n_frames = 8 if smoke else 24
+    warmup = 4 if smoke else 6
+    batch_size = 4 if smoke else 8
+    rate = 4e6 if smoke else 50e6  # bytes/s per direction
+    pool16 = _detector_like_frames(shape, seed=11)
+    frame_bytes = pool16[0].nbytes
+
+    # -- codec microbench: ratio + MB/s per registered codec --------------
+    codec_rows = {}
+    micro_pool = BufferPool()
+    for name in available_codecs():
+        codec = get_codec(name)
+        rec = FrameRecord(0, 0, pool16[0], 9.5)
+        parts = encode_payload_parts(rec)
+        best_c = best_d = float("inf")
+        wire_len = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            wparts, lease = compress_encoded_parts(rec, parts, codec, micro_pool)
+            best_c = min(best_c, time.perf_counter() - t0)
+            if lease is None:
+                break  # expansion fallback: nothing to time on decode
+            wire = b"".join(bytes(p) for p in wparts)
+            wire_len = len(wire)
+            from psana_ray_tpu.transport.codec import decode_payload
+
+            t0 = time.perf_counter()
+            out = decode_payload(wire)
+            best_d = min(best_d, time.perf_counter() - t0)
+            out.release()
+            lease.release()
+        raw_len = sum(
+            p.nbytes if isinstance(p, memoryview) else len(p) for p in parts
+        )
+        codec_rows[name] = {
+            "ratio": round(raw_len / wire_len, 2) if wire_len else 1.0,
+            "compress_mb_s": round(frame_bytes / 1e6 / best_c, 1),
+            "decompress_mb_s": (
+                round(frame_bytes / 1e6 / best_d, 1)
+                if best_d < float("inf")
+                else None
+            ),
+        }
+        log(
+            f"wire codec [{name}]: ratio {codec_rows[name]['ratio']}x, "
+            f"compress {codec_rows[name]['compress_mb_s']} MB/s, "
+            f"decompress {codec_rows[name]['decompress_mb_s']} MB/s "
+            f"(detector-like u16 {shape})"
+        )
+    extras["wire_compression_codecs"] = codec_rows
+
+    import subprocess as _subprocess
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def run_relay(codec_name, throttled=True, pool=None):
+        """REAL producer process -> throttled proxy -> server ->
+        throttled proxy -> streamed consumer (this process); returns
+        (fps, copies/frame, churn allocs/frame, proxy wire bytes).
+        Cross-process on purpose: the codec stages must burn separate
+        cores, as they do in any actual deployment (in-process threads
+        would serialize compress and decompress on the GIL)."""
+        pool = pool or BufferPool.default()
+        srv = TcpQueueServer(
+            RingBuffer(batch_size), host="127.0.0.1", pool=pool
+        ).serve_background()
+        proxy = (
+            ThrottleProxy("127.0.0.1", srv.port, rate, burst_s=0.05)
+            if throttled
+            else None
+        )
+        port = proxy.port if proxy else srv.port
+        codec_arg = None if codec_name == "none" else codec_name
+        cons = TcpQueueClient("127.0.0.1", port, pool=pool, codec=codec_arg)
+        total = warmup + n_frames
+        child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = _subprocess.Popen(
+            [
+                sys.executable, "-c",
+                "import sys; sys.path.insert(0, %r); "
+                "from bench import _wire_compression_producer as p; "
+                "p(%d, %r, %r, %d, 11)"
+                % (repo_root, port, codec_name, tuple(shape), total),
+            ],
+            env=child_env,
+        )
+
+        def watch_child():
+            # a producer that dies early must kill the drain, not hang it
+            rc = proc.wait()
+            if rc != 0:
+                srv.close_all()
+
+        try:
+            c0 = WIRE.stats()
+            threading.Thread(target=watch_child, daemon=True).start()
+            seen = 0
+            t0 = time.perf_counter()
+            m0 = None
+            seen_at_mark = 0
+            for batch in batches_from_queue(
+                cons, batch_size, poll_interval_s=0.001, prefer_stream=True
+            ):
+                seen += batch.num_valid
+                if m0 is None and seen >= warmup:
+                    m0 = pool.stats()
+                    t0 = time.perf_counter()
+                    seen_at_mark = seen
+            dt = time.perf_counter() - t0
+            proc.wait(timeout=60)
+            if m0 is None or seen != total:
+                raise RuntimeError(f"relay saw {seen}/{total} frames")
+            c1, m1 = WIRE.stats(), pool.stats()
+            steady = max(1, seen - seen_at_mark)
+            copies = (c1["copies_total"] - c0["copies_total"]) / max(1, seen)
+            allocs = (m1["churn_misses"] - m0["churn_misses"]) / steady
+            wire_bytes = (
+                proxy.bytes_forwarded("up") + proxy.bytes_forwarded("down")
+                if proxy
+                else None
+            )
+            return steady / dt, copies, allocs, wire_bytes
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                cons.disconnect()
+            except Exception:
+                pass
+            if proxy:
+                proxy.close()
+            srv.shutdown()
+
+    def best_of(n, *args, **kw):
+        """Best fps over n attempts: this box's CPU share fluctuates on
+        a seconds scale (the PR 5 convention for wall-clock rows —
+        contention can only slow a run down, never speed it up)."""
+        best = None
+        for _ in range(n):
+            r = run_relay(*args, **kw)
+            if best is None or r[0] > best[0]:
+                best = r
+        return best
+
+    # -- loopback parity row (default path untouched) ----------------------
+    fps_loop, _, _, _ = run_relay("none", throttled=False)
+    extras["wire_compression_loopback_fps"] = round(fps_loop, 1)
+    log(f"wire compression [loopback, uncompressed]: {fps_loop:.1f} fps")
+
+    # -- A/B through the ~50 MB/s bandwidth cap ----------------------------
+    relay_rows = {}
+    s0 = CODEC_STATS.stats()
+    fps_none, _, _, wire_none = best_of(2, "none")
+    relay_rows["none"] = {
+        "fps": round(fps_none, 2),
+        "wire_mb": round(wire_none / 1e6, 1),
+    }
+    log(
+        f"wire compression [throttled {rate / 1e6:.0f} MB/s, none]: "
+        f"{fps_none:.2f} fps, {wire_none / 1e6:.1f} MB on the wire"
+    )
+    for name in available_codecs():
+        ipool = BufferPool()  # instrumented: the compressed-path pins
+        fps_c, copies, allocs, wire_c = best_of(2, name, pool=ipool)
+        relay_rows[name] = {
+            "fps": round(fps_c, 2),
+            "wire_mb": round(wire_c / 1e6, 1),
+            "speedup": round(fps_c / fps_none, 2),
+            "copies_per_frame": round(copies, 3),
+            "allocs_per_frame": round(allocs, 3),
+        }
+        log(
+            f"wire compression [throttled {rate / 1e6:.0f} MB/s, {name}]: "
+            f"{fps_c:.2f} fps = {fps_c / fps_none:.2f}x uncompressed, "
+            f"{wire_c / 1e6:.1f} MB on the wire, {copies:.2f} copies/frame, "
+            f"{allocs:.3f} allocs/frame"
+        )
+    extras["wire_compression_relay"] = relay_rows
+    s1 = CODEC_STATS.stats()
+    extras["wire_compression_telemetry"] = {
+        "frames_compressed": s1["frames_compressed_total"]
+        - s0["frames_compressed_total"],
+        "cache_hits": s1["cache_hits_total"] - s0["cache_hits_total"],
+        "expansions": s1["expansions_total"] - s0["expansions_total"],
+        "ratio_out": s1["ratio_out"],
+    }
+    best = max(
+        (r["speedup"] for k, r in relay_rows.items() if k != "none"),
+        default=1.0,
+    )
+    extras["wire_compression_speedup"] = best
+    if smoke:
+        log(
+            f"wire compression [smoke]: plumbing exercised; speedup "
+            f"{best:.2f}x is NOT meaningful at smoke frame sizes (the "
+            f"throttle burst covers the whole run) — the acceptance "
+            f"number comes from the full-size section"
+        )
+    else:
+        log(
+            f"wire compression: best speedup {best:.2f}x through the "
+            f"{rate / 1e6:.0f} MB/s cap (acceptance >= 2x)"
+        )
 
 
 def _bench_durability(extras, smoke=False):

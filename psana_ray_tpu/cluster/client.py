@@ -123,6 +123,7 @@ class ClusterClient:
         reconnect_base_s: float = 0.2,
         heartbeat_s: float = 1.0,
         pool=None,
+        codec=None,
     ):
         self._addresses = parse_cluster_address(
             servers if isinstance(servers, str) else ",".join(servers)
@@ -138,6 +139,10 @@ class ClusterClient:
         self._reconnect_tries = reconnect_tries
         self._reconnect_base_s = reconnect_base_s
         self._pool = pool
+        # wire compression (ISSUE 9): negotiated PER PARTITION CONNECTION
+        # — each TcpQueueClient advertises this and its server picks, so
+        # a mixed-version cluster degrades per server, not per stream
+        self._codec = codec
         self._lock = threading.RLock()
         self._map = PartitionMap.compute(
             self._addresses, queue_name, n_partitions
@@ -275,6 +280,7 @@ class ClusterClient:
                     queue_name=partition_queue_name(self.queue_name, p),
                     reconnect_tries=1, reconnect_base_s=0.1,
                     pool=self._pool,
+                    codec=self._codec,  # backlog drains compressed too
                 )
             except TransportClosed:
                 continue  # old owner gone after all: nothing to drain
@@ -442,6 +448,7 @@ class ClusterClient:
                 reconnect_base_s=self._reconnect_base_s,
                 pool=self._pool,
                 put_window=self._put_window,
+                codec=self._codec,
             )
             self._clients[p] = c
         return c  # deferred resend flushes in _with_failover, once per op

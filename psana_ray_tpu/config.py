@@ -111,6 +111,19 @@ class TransportConfig:
     # second consumer group whose committed offset the replay advances.
     replay_from: str = ""
     replay_group: str = "replay"
+    # wire compression (ISSUE 9, tcp:// and cluster:// transports):
+    # codec(s) this endpoint ADVERTISES for its connections — the server
+    # picks per connection (opcode 'Z'). "" = never negotiate (wire
+    # bytes identical to pre-codec builds); "auto" = everything this
+    # build implements (pure-numpy shuffle-rle always, lz4/bitshuffle
+    # when installed); or an explicit name / comma list. Old peers
+    # degrade the connection to uncompressed, loudly but not fatally.
+    wire_codec: str = ""
+    # opt-in LOSSY wire dtype narrowing applied by the PRODUCER before
+    # encode ("" = off): e.g. "uint16" halves f32 frame bytes before
+    # compression even starts (records.narrow_panels — integer targets
+    # round + clip to the representable range)
+    wire_dtype: str = ""
 
 
 @dataclasses.dataclass

@@ -68,6 +68,17 @@ ALLOWLIST = (
         "hot-alloc", "records.py", "data = item.to_bytes()  # header-only, tiny",
         why="encode_into EOS arm: header-only marker, tens of bytes",
     ),
+    # -- lease-lifecycle --------------------------------------------------
+    Allow(
+        "lease-lifecycle", "transport/codec.py",
+        "dst_lease = pool.lease(panel_nbytes) if pool is not None else None",
+        why="LazyFrameRecord inflate: the lease is released in the "
+        "except-reraise arm on any decompress failure (which validate() "
+        "already proved impossible) and otherwise RETURNED to the panels "
+        "property, which attaches it to the record (frozen dataclass -> "
+        "object.__setattr__); record.release()/GC returns it. The "
+        "conditional-expression form hides the transfer from the checker",
+    ),
     # -- thread-hygiene ---------------------------------------------------
     Allow(
         "thread-hygiene", "psana_ray_tpu/producer.py",

@@ -30,7 +30,7 @@ from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, Sour
 from psana_ray_tpu.obs.flight import FLIGHT
 from psana_ray_tpu.obs.stages import HOP_ENQ, HOP_SRC, STAGE_ENQUEUE
 from psana_ray_tpu.obs.tracing import SPAN_PRODUCE, TRACER
-from psana_ray_tpu.records import EndOfStream, FrameRecord, mark_hop
+from psana_ray_tpu.records import EndOfStream, FrameRecord, mark_hop, narrow_panels
 from psana_ray_tpu.sources import open_source
 from psana_ray_tpu.transport import BackoffPolicy, Registry, TransportClosed, TransportWedged
 from psana_ray_tpu.transport.addressing import open_queue
@@ -244,6 +244,7 @@ class ProducerRuntime:
                 self._queue, backoff, self._stop, self.metrics, t.put_batch_size
             )
             produced = 0
+            wire_dtype = t.wire_dtype  # opt-in LOSSY narrowing (ISSUE 9)
             for idx, data, energy in source.iter_indexed_events(cfg.source.mode):
                 if self._stop.is_set():
                     break
@@ -252,6 +253,11 @@ class ProducerRuntime:
                     break
                 if mask is not None:
                     data = np.where(mask, data, 0)  # parity: producer.py:92-95
+                if wire_dtype:
+                    # narrow BEFORE encode: half (or less) the wire bytes
+                    # before the codec even runs — records.narrow_panels
+                    # rounds + clips integer targets
+                    data = narrow_panels(np.asarray(data), wire_dtype)
                 # sampled tracing gate: None on the unsampled hot path
                 # (zero allocations — counter arithmetic only)
                 trace_ctx = TRACER.maybe_trace()
@@ -389,11 +395,12 @@ def parse_arguments(argv=None):
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--log_level", default="INFO")
     from psana_ray_tpu.obs import add_metrics_args, add_trace_args
-    from psana_ray_tpu.transport.addressing import add_cluster_args
+    from psana_ray_tpu.transport.addressing import add_cluster_args, add_wire_args
 
     add_metrics_args(p)
     add_trace_args(p)
     add_cluster_args(p)
+    add_wire_args(p, producer=True)
     p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
     p.add_argument("--num_events", type=int, default=1024, help="synthetic events")
     p.add_argument(
@@ -416,7 +423,7 @@ def parse_arguments(argv=None):
         "contiguous processed watermark (at-least-once)",
     )
     a = p.parse_args(argv)
-    from psana_ray_tpu.transport.addressing import apply_cluster_args
+    from psana_ray_tpu.transport.addressing import apply_cluster_args, apply_wire_args
 
     return PipelineConfig(
         source=SourceConfig(
@@ -432,13 +439,16 @@ def parse_arguments(argv=None):
             cursor_path=a.cursor_path,
         ),
         mask=MaskConfig(a.uses_bad_pixel_mask, a.manual_mask_path),
-        transport=apply_cluster_args(
-            TransportConfig(
-                address=a.address,
-                namespace=a.namespace,
-                queue_name=a.queue_name,
-                queue_size=a.queue_size,
-                num_consumers=a.num_consumers,
+        transport=apply_wire_args(
+            apply_cluster_args(
+                TransportConfig(
+                    address=a.address,
+                    namespace=a.namespace,
+                    queue_name=a.queue_name,
+                    queue_size=a.queue_size,
+                    num_consumers=a.num_consumers,
+                ),
+                a,
             ),
             a,
         ),

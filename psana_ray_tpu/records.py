@@ -94,6 +94,17 @@ class FrameRecord:
     # the unsampled case) keeps the wire format at v2, byte-identical to
     # pre-tracing encoders.
     trace: Optional[TraceContext] = dataclasses.field(default=None, repr=False)
+    # Relay pass-through cache (ISSUE 9, never on the wire as a field):
+    # when this record was decoded from a COMPRESSED wire payload
+    # (transport/codec.py TAG_COMPRESSED), ``wire_cache`` is
+    # ``(codec_id, lease, payload_memoryview)`` — the exact compressed
+    # bytes, kept checked out alongside the decompressed panels. A
+    # relay pushing this record to a peer that negotiated the SAME
+    # codec re-sends those bytes verbatim (zero codec CPU per brokered
+    # frame); any other destination re-encodes from ``panels`` as
+    # usual. Released with :meth:`release` / dropped by
+    # :meth:`materialize`; GC of the lease is the backstop.
+    wire_cache: Optional[tuple] = dataclasses.field(default=None, repr=False)
 
     def __post_init__(self):
         panels = np.asarray(self.panels)
@@ -122,7 +133,12 @@ class FrameRecord:
 
         Call ONLY after the panel payload has been copied onward — the
         view in ``panels`` dies with the lease. Idempotent; no-op for
-        records that own their data."""
+        records that own their data. Also drops the compressed
+        ``wire_cache`` lease (its reuse window ends with the record)."""
+        cache = self.wire_cache
+        if cache is not None:
+            object.__setattr__(self, "wire_cache", None)
+            cache[1].release()
         lease = self.lease
         if lease is not None:
             object.__setattr__(self, "lease", None)
@@ -133,14 +149,17 @@ class FrameRecord:
         with the lease released. Use before re-enqueueing or retaining a
         view-backed record past its transport buffer (e.g. frames handed
         back to a queue whose slots those very leases occupy)."""
-        if self.lease is None:
+        if self.lease is None and self.wire_cache is None:
             return self
-        panels = self.panels.copy()
-        WIRE.add(panels.nbytes)
+        panels = self.panels.copy() if self.lease is not None else self.panels
+        if self.lease is not None:
+            WIRE.add(panels.nbytes)
         self.release()
         # replace() carries every other field — including the hops dict,
         # so stage timing survives materialization
-        return dataclasses.replace(self, panels=panels, lease=None)
+        return dataclasses.replace(
+            self, panels=panels, lease=None, wire_cache=None
+        )
 
     # -- wire format ------------------------------------------------------
     def wire_parts(self) -> tuple:
@@ -184,21 +203,9 @@ class FrameRecord:
         view into ``buf`` — the caller must keep ``buf`` alive/unchanged
         for the record's lifetime (the pooled transports do this by
         attaching the buffer's lease to the record)."""
-        magic, version, rank, idx, ndim, dtype_code, energy, ts = _FRAME_HEADER.unpack_from(buf, 0)
-        if magic != _FRAME_MAGIC:
-            raise ValueError(f"bad frame magic {magic:#x}")
-        if version > SCHEMA_VERSION:
-            raise ValueError(f"unsupported schema version {version}")
-        off = _FRAME_HEADER.size
-        shape = struct.unpack_from(f"<{ndim}q", buf, off)
-        off += 8 * ndim
-        trace = None
-        if version >= 3:  # sampled frame: trace context between shape and payload
-            trace = TraceContext.unpack_from(buf, off)
-            off += TraceContext.WIRE_SIZE
-        if dtype_code not in _CODE_DTYPES:
-            raise ValueError(f"unknown dtype code {dtype_code}")
-        dtype = _CODE_DTYPES[dtype_code]
+        rank, idx, shape, dtype, energy, ts, version, trace, off = (
+            parse_frame_header(buf)
+        )
         panels = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)), offset=off).reshape(shape)
         if copy:
             panels = panels.copy()
@@ -212,6 +219,116 @@ class FrameRecord:
             schema_version=version,
             trace=trace,
         )
+
+
+def parse_frame_header(buf) -> tuple:
+    """Parse a frame wire HEADER without touching payload bytes:
+    ``(shard_rank, event_idx, shape, dtype, photon_energy, timestamp,
+    version, trace, header_len)``. Raises ValueError on non-frame
+    bytes. THE wire-header grammar: :meth:`FrameRecord.from_bytes` is
+    this plus the payload ``frombuffer``, and the wire-compression
+    layer reads it off the raw head of a compressed payload
+    (transport/codec.py) to build a :class:`LazyFrameRecord` without
+    decompressing anything — a schema bump changes exactly one
+    parser."""
+    magic, version, rank, idx, ndim, dtype_code, energy, ts = _FRAME_HEADER.unpack_from(buf, 0)
+    if magic != _FRAME_MAGIC:
+        raise ValueError(f"bad frame magic {magic:#x}")
+    if version > SCHEMA_VERSION:
+        raise ValueError(f"unsupported schema version {version}")
+    off = _FRAME_HEADER.size
+    shape = struct.unpack_from(f"<{ndim}q", buf, off)
+    off += 8 * ndim
+    trace = None
+    if version >= 3:
+        trace = TraceContext.unpack_from(buf, off)
+        off += TraceContext.WIRE_SIZE
+    if dtype_code not in _CODE_DTYPES:
+        raise ValueError(f"unknown dtype code {dtype_code}")
+    return (rank, idx, shape, _CODE_DTYPES[dtype_code], energy, ts, version, trace, off)
+
+
+class LazyFrameRecord(FrameRecord):
+    """A FrameRecord decoded from a COMPRESSED wire payload without
+    decompressing the panels (ISSUE 9, server relay path): the header
+    fields are real — they ride the compressed payload raw — and
+    ``panels`` inflates on first touch through a codec-layer closure.
+    A relay that re-sends the record's cached compressed bytes
+    verbatim (``wire_cache`` pass-through) never touches panels, so a
+    same-codec broker pays ZERO codec CPU per brokered frame; every
+    other consumer of the record (mixed-codec push, durable log
+    encode, shm re-encode, in-process reads) sees an ordinary
+    FrameRecord that just decompresses at the first panel access.
+
+    Only codecs whose streams are cheaply VALIDATED up front may
+    produce these (codec ``validate()``): a corrupt payload must fail
+    AT RECEIVE — where the connection dies and the in-flight requeue
+    contract runs — never inside a later push to an innocent consumer
+    (a poison frame redelivering forever).
+
+    Built by the codec layer via :func:`make_lazy_frame` —
+    ``__init__``/``__post_init__`` are bypassed, and the ``panels``
+    property (a data descriptor, so it wins over any instance
+    attribute) carries the laziness."""
+
+    @property
+    def panels(self):  # type: ignore[override]
+        p = self.__dict__.get("_panels")
+        if p is None:
+            # inflate returns (panels, lease) and deliberately knows
+            # nothing about this record: a closure capturing the record
+            # would be a reference CYCLE (record -> closure -> record),
+            # and the pool leases would then wait on a gc pass instead
+            # of refcount death — a measured leak, not a theory
+            p, lease = self.__dict__["_inflate"]()
+            object.__setattr__(self, "_panels", p)
+            if lease is not None:
+                object.__setattr__(self, "lease", lease)
+        return p
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.__dict__["_panel_nbytes"])  # no inflate for stats
+
+    def materialize(self) -> "FrameRecord":
+        panels = self.panels.copy()
+        WIRE.add(panels.nbytes)
+        rec = FrameRecord(
+            shard_rank=self.shard_rank,
+            event_idx=self.event_idx,
+            panels=panels,
+            photon_energy=self.photon_energy,
+            timestamp=self.timestamp,
+            schema_version=self.schema_version,
+            hops=self.hops,
+            trace=self.trace,
+        )
+        self.release()
+        return rec
+
+
+def make_lazy_frame(
+    rank, idx, energy, ts, version, trace, panel_nbytes, inflate, wire_cache,
+) -> LazyFrameRecord:
+    """Codec-layer factory for :class:`LazyFrameRecord`: all header
+    fields are set directly (no __init__ — there are no panels yet);
+    ``inflate`` is a zero-arg closure returning ``(panels, lease)`` —
+    the decompressed typed view plus the pool lease backing it (None
+    off the pooled path). It must NOT reference the record (see the
+    panels property on cycles)."""
+    rec = object.__new__(LazyFrameRecord)
+    object.__setattr__(rec, "shard_rank", rank)
+    object.__setattr__(rec, "event_idx", idx)
+    object.__setattr__(rec, "photon_energy", energy)
+    object.__setattr__(rec, "timestamp", ts)
+    object.__setattr__(rec, "schema_version", version)
+    object.__setattr__(rec, "hops", None)
+    object.__setattr__(rec, "lease", None)
+    object.__setattr__(rec, "trace", trace)
+    object.__setattr__(rec, "wire_cache", wire_cache)
+    object.__setattr__(rec, "_panel_nbytes", int(panel_nbytes))
+    object.__setattr__(rec, "_inflate", inflate)
+    return rec
 
 
 def mark_hop(rec, hop: str, t: Optional[float] = None) -> None:
@@ -231,6 +348,49 @@ def mark_hop(rec, hop: str, t: Optional[float] = None) -> None:
         hops = {}
         object.__setattr__(rec, "hops", hops)
     hops[hop] = time.monotonic() if t is None else t
+
+
+def validate_wire_dtype(dtype_str: str) -> np.dtype:
+    """The one place the "is this dtype wire-codable" rule lives: CLI
+    validation (addressing.apply_wire_args) and the narrowing path
+    below both resolve through here."""
+    dtype = np.dtype(dtype_str)
+    if dtype not in _DTYPE_CODES:
+        raise ValueError(
+            f"wire dtype {dtype_str!r} is not wire-codable "
+            f"(supported: {sorted(str(d) for d in _DTYPE_CODES)})"
+        )
+    return dtype
+
+
+def narrow_panels(panels: np.ndarray, dtype_str: str) -> np.ndarray:
+    """Opt-in wire dtype narrowing (ISSUE 9, ``--wire_dtype``): convert
+    panels to a narrower wire dtype BEFORE encode, clipping integer
+    targets to their representable range (a f32 calibrated frame that
+    fits u16 halves its wire bytes before compression even starts;
+    calibration already emits narrow output dtypes, this applies the
+    same idea at the transport boundary). LOSSY by construction — the
+    operator opts in per stream. The target must be a wire-codable
+    dtype (``_DTYPE_CODES``); no-op when panels already match."""
+    dtype = validate_wire_dtype(dtype_str)
+    if panels.dtype == dtype:
+        return panels
+    if np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        if np.issubdtype(panels.dtype, np.floating):
+            src = np.rint(panels)
+            # calibrated frames mark bad pixels NaN; NaN→int casts are
+            # undefined in numpy (platform-dependent garbage), so map
+            # them to 0 — the usual masked-pixel convention. clip()
+            # already sends ±inf to the dtype bounds.
+            np.copyto(src, 0.0, where=np.isnan(src))
+        else:
+            src = panels
+        out = np.clip(src, info.min, info.max).astype(dtype)
+    else:
+        out = panels.astype(dtype)
+    WIRE.add(out.nbytes)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)

@@ -96,6 +96,48 @@ def apply_cluster_args(config: TransportConfig, args) -> TransportConfig:
     )
 
 
+def add_wire_args(parser, producer: bool = False) -> None:
+    """The shared wire-compression CLI surface (ISSUE 9)."""
+    parser.add_argument(
+        "--wire_codec", default="", metavar="auto|none|NAME[,NAME]",
+        help="negotiate per-connection wire compression with the queue "
+        "server (tcp:// and cluster:// transports): 'auto' advertises "
+        "every codec this build implements (pure-numpy shuffle-rle "
+        "always; lz4/bitshuffle when installed), a name advertises "
+        "exactly that. The server picks; old servers degrade the "
+        "connection to uncompressed. Default: off (wire bytes "
+        "byte-identical to pre-codec builds)",
+    )
+    if producer:
+        parser.add_argument(
+            "--wire_dtype", default="", metavar="DTYPE",
+            help="LOSSY opt-in: narrow panels to this dtype before "
+            "encode (e.g. uint16 halves f32 wire bytes; integer "
+            "targets round + clip). Off by default",
+        )
+
+
+def apply_wire_args(config: TransportConfig, args) -> TransportConfig:
+    """Fold the wire-compression flags into a TransportConfig."""
+    import dataclasses
+
+    codec = getattr(args, "wire_codec", "") or ""
+    dtype = getattr(args, "wire_dtype", "") or ""
+    if not codec and not dtype:
+        return config
+    if codec and codec != "none":
+        from psana_ray_tpu.transport.codec import get_codec
+
+        if codec != "auto":
+            for name in codec.split(","):
+                get_codec(name.strip())  # fail fast on unknown names
+    if dtype:
+        from psana_ray_tpu.records import validate_wire_dtype
+
+        validate_wire_dtype(dtype)  # fail fast, one shared rule
+    return dataclasses.replace(config, wire_codec=codec, wire_dtype=dtype)
+
+
 def open_queue(
     config: TransportConfig,
     role: str = "consumer",
@@ -108,6 +150,9 @@ def open_queue(
     if role not in ("producer", "consumer"):
         raise ValueError(f"role must be producer|consumer, got {role!r}")
     address = address or config.address
+    # one normalization of the codec knob for every TCP-family branch:
+    # ""/"none" -> no negotiation
+    wire_codec = config.wire_codec if config.wire_codec not in ("", "none") else None
 
     if address in ("auto", "local"):
         reg = registry or Registry.default()
@@ -168,6 +213,7 @@ def open_queue(
             maxsize=config.queue_size,
             group=group or None,
             member_id=config.member_id or None,
+            codec=wire_codec,
         )
 
     if address.startswith("tcp://"):
@@ -185,6 +231,7 @@ def open_queue(
             namespace=config.namespace,
             queue_name=config.queue_name,
             maxsize=config.queue_size,
+            codec=wire_codec,
         )
 
     raise ValueError(

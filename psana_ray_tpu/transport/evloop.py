@@ -71,8 +71,11 @@ from psana_ray_tpu.obs.tracing import TRACER
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY
 from psana_ray_tpu.transport.codec import (
+    CODEC_NONE,
+    CODEC_STATS,
     decode_payload as _decode,
-    encode_payload_parts as _encode_parts,
+    encode_for_wire as _wire_encode,
+    negotiate_codec,
     payload_nbytes as _parts_nbytes,
 )
 from psana_ray_tpu.storage.log import COMMIT_DELIVERED
@@ -82,6 +85,7 @@ from psana_ray_tpu.transport.tcp import (
     _OP_BYE,
     _OP_CLOSE,
     _OP_CLUSTER,
+    _OP_CODEC,
     _OP_COMMIT,
     _OP_GET,
     _OP_GET_BATCH,
@@ -251,6 +255,7 @@ class _EvConn:
     __slots__ = (
         "loop", "sock", "srv", "queue", "in_flight", "out", "out_bytes",
         "closing", "closed", "stream", "replay", "pending", "op_gen",
+        "codec", "_out_enq_total", "_out_releases",
         "_hdr", "_hdr_mv", "_target", "_need", "_got", "_cb", "_lease",
         "_want_read", "_want_write", "_mask", "_sendmsg",
         "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq", "_r_from",
@@ -271,6 +276,16 @@ class _EvConn:
         self.closing = False  # flush remaining out bytes, then close
         self.closed = False
         self.stream: Optional[_StreamState] = None
+        # negotiated wire codec ('Z', ISSUE 9): frame payloads SENT on
+        # this connection compress with it (relay pass-through reuses a
+        # record's cached compressed bytes when the codec matches);
+        # receives are tag-driven and need no per-connection state
+        self.codec = None
+        # compressed staging leases awaiting flush: (enqueued-bytes
+        # mark, lease) released once the outbound byte counter passes
+        # the mark — a lease must outlive its queued memoryview
+        self._out_enq_total = 0
+        self._out_releases: deque = deque()
         # durable replay cursor ('R'): when set, this connection's reads
         # serve the log non-destructively instead of popping the queue
         self.replay = None
@@ -364,10 +379,18 @@ class _EvConn:
         self.loop.add_liveness_probe(self)
 
     # -- write engine -----------------------------------------------------
-    def send_parts(self, parts) -> None:
+    def send_parts(self, parts, release=None) -> None:
+        """Queue parts for sending. ``release`` (a lease or list of
+        leases backing compressed parts) is released once every byte
+        queued SO FAR has left for the kernel — never while a queued
+        memoryview still references the lease's buffer."""
         for m in _gather_parts(parts):
             self.out.append(m)
             self.out_bytes += m.nbytes
+            self._out_enq_total += m.nbytes
+        if release is not None:
+            for lease in release if isinstance(release, list) else (release,):
+                self._out_releases.append((self._out_enq_total, lease))
         self.flush_out()
 
     def _send_control(self, b: bytes) -> None:
@@ -400,6 +423,10 @@ class _EvConn:
                         sent = 0
         except (BlockingIOError, InterruptedError):
             pass
+        # release compressed staging leases whose bytes have fully left
+        sent_total = self._out_enq_total - self.out_bytes
+        while self._out_releases and self._out_releases[0][0] <= sent_total:
+            self._out_releases.popleft()[1].release()
         if not self.out and self.closing:
             self.loop.kill_conn(self, None, requeue=False)
             return
@@ -484,29 +511,52 @@ class _EvConn:
             self.loop.kill_conn(self, None, requeue=False)
 
     # -- responses --------------------------------------------------------
+    def _encode_item_parts(self, item):
+        """codec.encode_for_wire under this connection's negotiated
+        codec — the returned staging lease is handed to
+        send_parts(release=...) so it outlives the queued bytes. See
+        the helper for the lease/pass-through contract."""
+        return _wire_encode(item, self.codec, self.srv._pool)
+
     def _respond_item(self, item) -> None:
-        parts = _encode_parts(item)
+        parts, clease = self._encode_item_parts(item)
         head = _ST_OK + struct.pack("<I", _parts_nbytes(parts))
-        self.send_parts([head, *parts])
+        self.send_parts([head, *parts], release=clease)
 
     def _respond_batch(self, items) -> None:
         self.in_flight = list(items)
         parts: List[Any] = [_ST_OK, struct.pack("<I", len(self.in_flight))]
-        for item in self.in_flight:
-            item_parts = _encode_parts(item)
-            parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
-            parts.extend(item_parts)
+        leases: List[Any] = []
+        try:
+            for item in self.in_flight:
+                item_parts, clease = self._encode_item_parts(item)
+                if clease is not None:
+                    leases.append(clease)
+                parts.append(struct.pack("<I", _parts_nbytes(item_parts)))
+                parts.extend(item_parts)
+        except BaseException:
+            # a mid-loop failure (allocation under pressure) must not
+            # strand earlier items' staging leases: nothing was queued
+            # yet, so ownership is still ours
+            for clease in leases:
+                clease.release()
+            raise
         t_send0 = time.monotonic() if TRACER.enabled else 0.0
-        self.send_parts(parts)
+        self.send_parts(parts, release=leases or None)
         if TRACER.enabled:
             _emit_relay_spans(self.in_flight, t_send0)
 
     def _take_item(self):
-        """Decode the just-received payload zero-copy off its lease."""
+        """Decode the just-received payload zero-copy off its lease.
+        ``lazy=True``: a COMPRESSED frame is validated (corruption
+        still dies here, where the requeue contract runs) but not
+        decompressed — the relay's common case re-sends the cached
+        compressed bytes verbatim and never pays codec CPU; panels
+        inflate on first touch for every other destination."""
         lease = self._lease
         self._lease = None
         try:
-            return _decode(lease.mv, lease=lease)
+            return _decode(lease.mv, lease=lease, lazy=True)
         except BaseException:
             lease.release()
             raise
@@ -767,15 +817,24 @@ class _EvConn:
         st = self.stream
         t_send0 = time.monotonic() if TRACER.enabled else 0.0
         parts: List[Any] = []
-        for item in items:
-            st.seq += 1
-            st.unacked.append((st.seq, item))
-            item_parts = _encode_parts(item)
-            parts.append(
-                _ST_OK + struct.pack("<QI", st.seq, _parts_nbytes(item_parts))
-            )
-            parts.extend(item_parts)
-        self.send_parts(parts)
+        leases: List[Any] = []
+        try:
+            for item in items:
+                st.seq += 1
+                st.unacked.append((st.seq, item))
+                item_parts, clease = self._encode_item_parts(item)
+                if clease is not None:
+                    leases.append(clease)
+                parts.append(
+                    _ST_OK
+                    + struct.pack("<QI", st.seq, _parts_nbytes(item_parts))
+                )
+                parts.extend(item_parts)
+        except BaseException:
+            for clease in leases:  # nothing queued yet: still ours
+                clease.release()
+            raise
+        self.send_parts(parts, release=leases or None)
         STREAM.pushed(len(items))
         if TRACER.enabled:
             _emit_relay_spans(items, t_send0)
@@ -921,6 +980,30 @@ class _EvConn:
             self._send_control(_ST_OK)
         self._await_op()
 
+    # -- wire-compression negotiation ('Z', ISSUE 9) ----------------------
+    def _op_codec(self) -> None:
+        self._expect(2, self._codec_len)
+
+    def _codec_len(self) -> None:
+        (n,) = struct.unpack_from("<H", self._hdr)
+        if n > 4096:  # a codec-name list is tens of bytes
+            raise ConnectionError(f"codec negotiation payload {n} bytes")
+        self._open_buf = bytearray(n)
+        self._arm(memoryview(self._open_buf), self._codec_finish)
+
+    def _codec_finish(self) -> None:
+        names = self._open_buf.decode().split(",")
+        chosen = negotiate_codec(names)
+        self.codec = chosen
+        name = chosen.name if chosen is not None else CODEC_NONE
+        CODEC_STATS.negotiated(name)
+        FLIGHT.record(
+            "codec_negotiated", port=self.srv.port, codec=name, server=True
+        )
+        nb = name.encode()
+        self.send_parts([_ST_OK + struct.pack("<H", len(nb)) + nb])
+        self._await_op()
+
     def _op_open(self) -> None:
         self._expect(2, self._open_ns_len)
 
@@ -970,6 +1053,7 @@ _OPS: Dict[int, str] = {
     _OP_CLUSTER[0]: "_op_cluster",
     _OP_REPLAY[0]: "_op_replay",
     _OP_COMMIT[0]: "_op_commit",
+    _OP_CODEC[0]: "_op_codec",
     _OP_BYE[0]: "_op_bye",
 }
 
@@ -1099,6 +1183,8 @@ class EventLoop:
         if conn._lease is not None:  # payload died mid-read
             conn._lease.release()
             conn._lease = None
+        while conn._out_releases:  # compressed parts died queued
+            conn._out_releases.popleft()[1].release()
         # a parked 'U'/'W' item was never enqueued: drop it — the client
         # is dead (its windowed-put resend redelivers on reconnect), and
         # enqueueing now would stack a duplicate on top of that resend

@@ -60,6 +60,17 @@ Wire protocol (all little-endian):
                   offset (offset u64 max = "everything delivered to this
                   connection's replay cursor so far"); '0' when the
                   bound queue has no log
+              'Z' (codec negotiate) + len:u16 + comma-separated codec
+                  names — wire-compression capability exchange (ISSUE
+                  9): the client advertises the codecs it can decode,
+                  in preference order; the server picks the first one
+                  it also implements (or "none") and BOTH sides apply
+                  it to frame payloads on THIS connection from the
+                  next message on (payload tag 'C', transport/codec.py;
+                  a frame that expands under the codec still ships raw
+                  — compression is an encoding, never a requirement).
+                  Clients that never negotiate see byte-identical wire
+                  traffic to pre-codec peers
               'F' (bye) — no response; acks the last delivery and ends
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
@@ -72,6 +83,7 @@ Wire protocol (all little-endian):
               + [N ok] len:u32 + JSON group-state object
               + [R ok] start:u64 + end:u64 (resolved cursor start and
                 the log tail at open time; the cursor follows the tail)
+              + [Z ok] len:u16 + chosen codec name ("none" = stay raw)
     stream push (server -> client, after 'M'):
               status:u8 ('1') + seq:u64 + len:u32 + payload per frame;
               'X' when the bound queue closes (the stream is over)
@@ -197,8 +209,12 @@ from psana_ray_tpu.records import mark_hop
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY, RingBuffer
 from psana_ray_tpu.transport.codec import (
+    CODEC_NONE,
+    CODEC_STATS,
+    available_codecs,
     decode_payload as _decode,
-    encode_payload_parts as _encode_parts,
+    encode_for_wire as _wire_encode,
+    get_codec,
     payload_nbytes as _parts_nbytes,
 )
 from psana_ray_tpu.utils.bufpool import BufferPool
@@ -221,6 +237,7 @@ _OP_ANCHOR = b"A"
 _OP_CLUSTER = b"N"
 _OP_REPLAY = b"R"
 _OP_COMMIT = b"J"
+_OP_CODEC = b"Z"
 _OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
@@ -782,7 +799,16 @@ class TcpQueueClient:
         reconnect_base_s: float = 0.5,
         pool: Optional[BufferPool] = None,
         put_window: int = DEFAULT_STREAM_WINDOW,
+        codec: Optional[str] = None,
     ):
+        """``codec`` opts this connection into wire compression (ISSUE
+        9): ``"auto"`` advertises every codec this build implements,
+        a name (or comma list) advertises exactly those; None/"none"
+        (the default) skips negotiation entirely — wire bytes stay
+        byte-identical to pre-codec clients. The SERVER picks the
+        codec (opcode 'Z'); an old server that answers the opcode with
+        a protocol error degrades this client to uncompressed, loudly
+        (flight breadcrumb), not fatally."""
         self.host, self.port = host, port
         self._timeout_s = timeout_s
         # pooled receive staging: GET/B payloads land via recv_into in
@@ -808,6 +834,21 @@ class TcpQueueClient:
         self._put_seq = 0  # guarded-by: _lock
         self._put_unacked: deque = deque()  # (seq, item)  # guarded-by: _lock
         self._put_window = max(1, int(put_window))
+        # wire compression (ISSUE 9): the advertised codec list, the
+        # NEGOTIATED codec object (None = uncompressed), and the
+        # old-peer latch that stops renegotiation storms on reconnect
+        self._codec_arg = codec
+        self._codec_names: Optional[List[str]] = None
+        if codec and codec != CODEC_NONE:
+            if codec == "auto":
+                self._codec_names = available_codecs() or None
+            else:
+                names = [n.strip() for n in codec.split(",") if n.strip()]
+                for n in names:
+                    get_codec(n)  # fail fast on unknown names
+                self._codec_names = names
+        self._codec = None  # guarded-by: _lock
+        self._codec_refused = False  # guarded-by: _lock
         # the INITIAL dial goes through the same backoff machinery as
         # mid-stream drops: a consumer starting while the server is mid-
         # restart under a supervisor must wait it out, not crash with a
@@ -820,6 +861,8 @@ class TcpQueueClient:
             self._reconnect(e)  # raises TransportClosed when exhausted
         if namespace is not None or queue_name is not None:
             self.open(namespace or "default", queue_name or "default", maxsize)
+        if self._codec_names:
+            self._negotiate()
 
     def open(self, namespace: str, queue_name: str, maxsize: int = 0):
         """Bind this connection to the server-side queue named
@@ -844,6 +887,64 @@ class TcpQueueClient:
             + struct.pack("<I", maxsize)
         )
         self._status()
+
+    # -- wire-compression negotiation (opcode 'Z', ISSUE 9) ---------------
+    def _negotiate(self):
+        with self._lock:
+            try:
+                self._negotiate_raw()
+            except (ConnectionError, socket.timeout, OSError) as e:
+                self._reconnect(e)  # renegotiates itself on success
+
+    def _negotiate_raw(self):
+        """One 'Z' exchange on the current socket. A peer that predates
+        the opcode answers protocol-error (and drops the connection):
+        that DEGRADES this client to uncompressed — latched, so
+        reconnects stop re-asking — instead of failing the transport.
+        Caller holds ``self._lock``."""
+        # guarded-by-caller: _lock
+        if self._codec_refused:
+            return
+        names = ",".join(self._codec_names).encode()
+        self._sock.sendall(_OP_CODEC + struct.pack("<H", len(names)) + names)
+        try:
+            self._status()
+        except RuntimeError:
+            # old peer: 'E' answer, connection about to close server-side.
+            # Degrade to uncompressed; the next op reconnects normally.
+            self._codec = None
+            self._codec_refused = True
+            FLIGHT.record(
+                "codec_refused", host=self.host, port=self.port
+            )
+            return
+        (n,) = struct.unpack("<H", _recv_exact(self._sock, 2))
+        try:
+            chosen = _recv_exact(self._sock, n).decode()
+            self._codec = get_codec(chosen)
+        except ValueError:
+            # buggy peer/proxy: a name we never advertised (or not even
+            # UTF-8). Same contract as the old-peer refusal: degrade to
+            # uncompressed and latch, never fail the transport.
+            self._codec = None
+            self._codec_refused = True
+            FLIGHT.record(
+                "codec_refused", host=self.host, port=self.port
+            )
+            return
+        CODEC_STATS.negotiated(chosen)
+        FLIGHT.record(
+            "codec_negotiated", host=self.host, port=self.port, codec=chosen
+        )
+
+    def _encode_for_wire(self, item):
+        """codec.encode_for_wire under this connection's negotiated
+        codec — every put path calls this under the client lock (the
+        negotiated codec is per-connection state a racing reconnect
+        may flip). See the helper for the lease/pass-through
+        contract."""
+        # guarded-by-caller: _lock
+        return _wire_encode(item, self._codec, self._pool)
 
     def _reconnect(self, cause: BaseException, deadline: Optional[float] = None):
         """Re-dial with exponential backoff and replay the named binding.
@@ -891,6 +992,12 @@ class TcpQueueClient:
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._binding is not None:
                     self._open_raw(*self._binding)
+                if self._codec_names:
+                    # renegotiate BEFORE any payload-bearing replay: the
+                    # windowed resend below must know whether this
+                    # connection compresses (an old-peer refusal latches
+                    # and the resend simply goes out raw)
+                    self._negotiate_raw()
                 if self._replay_args is not None:
                     # re-open the replay cursor at the group's committed
                     # offset: everything unconfirmed redelivers (dupes
@@ -985,9 +1092,15 @@ class TcpQueueClient:
         with the new socket already dialed and the binding replayed."""
         # guarded-by-caller: _lock
         for seq, item in list(self._put_unacked):
-            parts = _encode_parts(item)
-            head = _OP_PUT_SEQ + struct.pack("<QI", seq, _parts_nbytes(parts))
-            _sendmsg_all(self._sock, [head, *parts])
+            parts, clease = self._encode_for_wire(item)
+            try:
+                head = _OP_PUT_SEQ + struct.pack(
+                    "<QI", seq, _parts_nbytes(parts)
+                )
+                _sendmsg_all(self._sock, [head, *parts])
+            finally:
+                if clease is not None:
+                    clease.release()
         n = len(self._put_unacked)
         if n:
             STREAM.resent(n)
@@ -1064,27 +1177,34 @@ class TcpQueueClient:
         shutdown)."""
         if self._stream is not None:
             return self._side_channel().put_pipelined(item, deadline)
-        parts = _encode_parts(item)
-        n = _parts_nbytes(parts)
-        if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
-            raise ValueError(
-                f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}"
-            )
         with self._lock:
             if not self._drain_put_acks(self._put_window - 1, deadline):
                 return False
-            self._put_seq += 1
-            seq = self._put_seq
-            self._put_unacked.append((seq, item))
-            STREAM.put_depth(len(self._put_unacked))
-            head = _OP_PUT_SEQ + struct.pack("<QI", seq, n)
+            # encode under the lock: the negotiated codec is per-
+            # connection state a racing reconnect may flip
+            parts, clease = self._encode_for_wire(item)
             try:
-                _sendmsg_all(self._sock, [head, *parts])
-            except (ConnectionError, socket.timeout, OSError) as e:
-                # full-envelope reconnect (no caller deadline: see the
-                # docstring) resends the whole tail — including this
-                # item, already appended above
-                self._reconnect(e)
+                n = _parts_nbytes(parts)
+                if n > _MAX_PAYLOAD:  # fail fast: peer would drop the conn
+                    raise ValueError(
+                        f"payload of {n} bytes exceeds wire maximum "
+                        f"{_MAX_PAYLOAD}"
+                    )
+                self._put_seq += 1
+                seq = self._put_seq
+                self._put_unacked.append((seq, item))
+                STREAM.put_depth(len(self._put_unacked))
+                head = _OP_PUT_SEQ + struct.pack("<QI", seq, n)
+                try:
+                    _sendmsg_all(self._sock, [head, *parts])
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    # full-envelope reconnect (no caller deadline: see
+                    # the docstring) resends the whole tail — including
+                    # this item, already appended above
+                    self._reconnect(e)
+            finally:
+                if clease is not None:
+                    clease.release()
             return True
 
     def flush_puts(self, deadline: Optional[float] = None) -> bool:
@@ -1146,6 +1266,7 @@ class TcpQueueClient:
                 reconnect_base_s=self._reconnect_base_s,
                 pool=self._pool,
                 put_window=self._put_window,
+                codec=self._codec_arg,
             )
             self._side = side
         return side
@@ -1154,18 +1275,30 @@ class TcpQueueClient:
     def put(self, item: Any, deadline: Optional[float] = None) -> bool:
         if self._stream is not None:  # streamed conn: puts use the side channel
             return self._side_channel().put(item, deadline)
+
         # scatter-gather: the frame payload goes to the kernel straight
         # from the record's panel memory (wire_parts memoryview) — no
-        # to_bytes() serialization copy, no request-assembly concat copy
-        parts = _encode_parts(item)
-        n = _parts_nbytes(parts)
-        if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
-            raise ValueError(f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}")
-        head = _OP_PUT + struct.pack("<I", n)
-
+        # to_bytes() serialization copy, no request-assembly concat copy.
+        # A negotiated codec stages the compressed form in a pool lease,
+        # released once the exchange is over. Encoding happens INSIDE
+        # the retried exchange: a reconnect may renegotiate (or an
+        # old-peer refusal may downgrade) the codec, and the retry must
+        # send what THIS connection speaks, never stale compressed parts.
         def _do():
-            _sendmsg_all(self._sock, [head, *parts])
-            return self._status() == _ST_OK
+            parts, clease = self._encode_for_wire(item)
+            try:
+                n = _parts_nbytes(parts)
+                if n > _MAX_PAYLOAD:  # fail fast: peer would drop the conn
+                    raise ValueError(
+                        f"payload of {n} bytes exceeds wire maximum "
+                        f"{_MAX_PAYLOAD}"
+                    )
+                head = _OP_PUT + struct.pack("<I", n)
+                _sendmsg_all(self._sock, [head, *parts])
+                return self._status() == _ST_OK
+            finally:
+                if clease is not None:
+                    clease.release()
 
         with self._lock:
             return self._retrying(_do, deadline)
@@ -1436,30 +1569,52 @@ class TcpQueueClient:
 
         if self._stream is not None:
             return self._side_channel().put_wait(item, timeout, poll_s)
-        parts = _encode_parts(item)
-        n = _parts_nbytes(parts)
-        if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
-            raise ValueError(
-                f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}"
-            )
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            # server-side bounded wait for SPACE ('U'): a full queue costs
-            # one round trip per cap interval, not one rejected put per
-            # poll tick
-            wait_ms = int(self._server_wait(deadline) * 1000)
-            head = _OP_PUT_WAIT + struct.pack("<II", wait_ms, n)
+        # the compressed bytes depend only on (item, codec), so the
+        # encode is CACHED across full-queue retries — paying the codec
+        # once per frame, not once per bounded-wait round trip — and
+        # invalidated when a reconnect mid-attempt renegotiates the
+        # codec (get_codec returns per-name singletons, so identity is
+        # the negotiation generation; the retry then re-encodes to what
+        # this connection now speaks). The staging lease lives until
+        # the put resolves.
+        cached = None  # (codec, parts, staging_lease)
+        try:
+            while True:
+                # server-side bounded wait for SPACE ('U'): a full queue
+                # costs one round trip per cap interval, not one
+                # rejected put per poll tick
+                wait_ms = int(self._server_wait(deadline) * 1000)
 
-            def _do():
-                _sendmsg_all(self._sock, [head, *parts])
-                return self._status() == _ST_OK
+                def _do():
+                    nonlocal cached
+                    codec = self._codec
+                    if cached is None or cached[0] is not codec:
+                        if cached is not None and cached[2] is not None:
+                            cached[2].release()
+                        cached = None
+                        parts, clease = self._encode_for_wire(item)
+                        cached = (codec, parts, clease)
+                    parts = cached[1]
+                    n = _parts_nbytes(parts)
+                    if n > _MAX_PAYLOAD:  # fail fast
+                        raise ValueError(
+                            f"payload of {n} bytes exceeds wire maximum "
+                            f"{_MAX_PAYLOAD}"
+                        )
+                    head = _OP_PUT_WAIT + struct.pack("<II", wait_ms, n)
+                    _sendmsg_all(self._sock, [head, *parts])
+                    return self._status() == _ST_OK
 
-            with self._lock:
-                if self._retrying(_do, deadline):
-                    return True
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            time.sleep(poll_s)
+                with self._lock:
+                    if self._retrying(_do, deadline):
+                        return True
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                time.sleep(poll_s)
+        finally:
+            if cached is not None and cached[2] is not None:
+                cached[2].release()
 
     def get_batch(
         self,
@@ -1526,20 +1681,32 @@ class TcpQueueClient:
         the server accepted (a full queue truncates — retry the rest).
         Scatter-gather like :meth:`put`: N frames leave straight from
         their panel memory, never assembled into one request buffer."""
-        parts = [_OP_PUT_BATCH + struct.pack("<I", len(items))]
-        for item in items:
-            item_parts = _encode_parts(item)
-            n = _parts_nbytes(item_parts)
-            if n > _MAX_PAYLOAD:  # fail fast: the peer would drop the conn
-                raise ValueError(f"payload of {n} bytes exceeds wire maximum {_MAX_PAYLOAD}")
-            parts.append(struct.pack("<I", n))
-            parts.extend(item_parts)
 
+        # the whole request assembles INSIDE the retried exchange so a
+        # post-reconnect retry re-encodes under the renegotiated codec
         def _do():
-            _sendmsg_all(self._sock, parts)
-            self._status()
-            (accepted,) = struct.unpack("<I", _recv_exact(self._sock, 4))
-            return accepted
+            parts = [_OP_PUT_BATCH + struct.pack("<I", len(items))]
+            leases = []
+            try:
+                for item in items:
+                    item_parts, clease = self._encode_for_wire(item)
+                    if clease is not None:
+                        leases.append(clease)
+                    n = _parts_nbytes(item_parts)
+                    if n > _MAX_PAYLOAD:  # fail fast
+                        raise ValueError(
+                            f"payload of {n} bytes exceeds wire maximum "
+                            f"{_MAX_PAYLOAD}"
+                        )
+                    parts.append(struct.pack("<I", n))
+                    parts.extend(item_parts)
+                _sendmsg_all(self._sock, parts)
+                self._status()
+                (accepted,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+                return accepted
+            finally:
+                for clease in leases:
+                    clease.release()
 
         with self._lock:
             return self._retrying(_do)

@@ -69,6 +69,14 @@ class Lease:
     def nbytes(self) -> int:
         return len(self.mv)
 
+    @property
+    def pool(self) -> "BufferPool":
+        """The owning pool — lets a decoder that was handed only a lease
+        (e.g. the wire-compression decompressor, transport/codec.py)
+        stage its output in a sibling lease from the SAME pool instead
+        of threading the pool through every call site."""
+        return self._pool
+
     def release(self):
         if self._released:
             return

@@ -11,6 +11,13 @@ local port and forward to a destination.
     Fixed one-way latency, unlimited bandwidth (per-direction delay
     lines with chunk coalescing) — models RTT, not throughput.
 
+:class:`ThrottleProxy`
+    Token-bucket bytes/s cap per direction, zero added latency —
+    models BANDWIDTH, not RTT (the wire-compression A/B's honest
+    adversary: a 50 MB/s tunnel does not care how many round trips
+    you saved). Each direction has its own bucket, like a full-duplex
+    link.
+
 :class:`FaultProxy`
     Byte-counting fault injector. Faults are armed per direction
     (``"up"`` = client->server, ``"down"`` = server->client):
@@ -139,6 +146,113 @@ class DelayProxy:
     def close(self):
         self._stop.set()
         for s in [self._lsock, *self._socks]:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class ThrottleProxy:
+    """TCP proxy capping each direction at ``bytes_per_s`` with a token
+    bucket (burst = ``burst_s`` seconds of rate): chunks are forwarded
+    in bounded slices, each waiting for its tokens — throughput
+    converges to the cap from below, with no artificial latency while
+    tokens remain. One bucket per direction, shared across every
+    proxied connection (the directions of one physical link contend
+    with themselves, exactly like a real full-duplex tunnel)."""
+
+    # forwarding granularity: big enough that pacing sleeps are several
+    # ms each (sub-ms sleeps on a loaded 2-core box wake late and
+    # throttle BELOW the cap — the proxy must model the link, not the
+    # scheduler), small enough that the burst bucket still smooths it
+    _SLICE = 256 * 1024
+    _MIN_SLEEP_S = 0.004  # debts below this accrue in the bucket instead
+
+    def __init__(self, dst_host: str, dst_port: int, bytes_per_s: float, burst_s: float = 0.25):
+        self.bytes_per_s = float(bytes_per_s)
+        self._burst = self.bytes_per_s * burst_s
+        self._dst = (dst_host, dst_port)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._socks = []  # guarded-by: _lock
+        now = time.monotonic()
+        # direction -> [tokens, last_refill]
+        self._bucket = {"up": [self._burst, now], "down": [self._burst, now]}  # guarded-by: _lock
+        self._bytes = {"up": 0, "down": 0}  # guarded-by: _lock
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.port = self._lsock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def bytes_forwarded(self, direction: str) -> int:
+        with self._lock:
+            return self._bytes[direction]
+
+    def _take(self, direction: str, n: int) -> float:
+        """Deduct ``n`` tokens; returns how long the caller must sleep
+        before forwarding (0 when the bucket covers the chunk)."""
+        with self._lock:
+            bucket = self._bucket[direction]
+            now = time.monotonic()
+            bucket[0] = min(
+                self._burst, bucket[0] + (now - bucket[1]) * self.bytes_per_s
+            )
+            bucket[1] = now
+            bucket[0] -= n
+            wait = -bucket[0] / self.bytes_per_s if bucket[0] < 0 else 0.0
+            self._bytes[direction] += n
+            return wait
+
+    def _accept(self):
+        self._lsock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                dst = socket.create_connection(self._dst, timeout=5.0)
+            except OSError:
+                conn.close()
+                continue
+            for s in (conn, dst):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._socks += [conn, dst]
+            threading.Thread(
+                target=self._pump, args=(conn, dst, "up"), daemon=True
+            ).start()
+            threading.Thread(
+                target=self._pump, args=(dst, conn, "down"), daemon=True
+            ).start()
+
+    def _pump(self, src, dst, direction: str):
+        try:
+            while not self._stop.is_set():
+                data = src.recv(self._SLICE)
+                if not data:
+                    break
+                wait = self._take(direction, len(data))
+                if wait >= self._MIN_SLEEP_S:  # smaller debts stay banked
+                    time.sleep(wait)
+                dst.sendall(data)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        with self._lock:
+            socks, self._socks = self._socks, []
+        for s in [self._lsock, *socks]:
             try:
                 s.close()
             except OSError:

@@ -1,0 +1,32 @@
+# lint: hot-path
+"""BAD: per-frame allocation idioms inside a wire-compression codec —
+the compress/decompress hot path runs once per brokered frame, so
+frame-sized serialization copies, raw recv, and bytes materialization
+are exactly as banned here as on the rest of the datapath (ISSUE 9
+satellite)."""
+
+
+def compress_frame(rec, dst):
+    # serializing the record to bytes before compressing is a
+    # frame-sized copy the scatter-gather parts already avoid
+    raw = rec.to_bytes()
+    dst[: len(raw)] = raw
+    return len(raw)
+
+
+def compress_panels(panels, dst):
+    # frame-sized ndarray -> bytes copy just to feed the encoder
+    blob = panels.tobytes()
+    dst[: len(blob)] = blob
+    return len(blob)
+
+
+def recv_compressed(sock, n):
+    # a fresh bytes object per compressed payload; recv_into a pooled
+    # lease is the sanctioned receive
+    return sock.recv(n)
+
+
+def stage_compressed(mv):
+    # bytes(...) materialization of the staging buffer before sending
+    return bytes(mv)

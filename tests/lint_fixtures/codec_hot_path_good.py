@@ -1,0 +1,40 @@
+# lint: hot-path
+"""GOOD: the sanctioned wire-compression idioms — codec transforms
+stage through caller-owned buffers (pool leases), array pieces land in
+the destination memoryview via ``.data.cast("B")`` views, and receives
+fill pooled leases with ``recv_into`` (ISSUE 9 satellite)."""
+
+import struct
+
+import numpy as np
+
+_HDR = struct.Struct("<BBII")
+
+
+def compress_frame(parts, codec, itemsize, pool):
+    # compress the payload PART into a lease; the head stays its own
+    # small part — no contiguous assembly of the frame
+    head, body = parts
+    out = pool.lease(body.nbytes)
+    n = codec.compress(body, itemsize, out.mv)
+    if n is None:
+        out.release()
+        return parts, None
+    return [head, out.mv[:n]], out
+
+
+def emit_plane(dst, off, arr):
+    # array pieces land via a zero-copy memoryview of the array
+    a = np.ascontiguousarray(arr)
+    end = off + a.nbytes
+    dst[off:end] = a.data.cast("B")
+    return end
+
+
+def recv_compressed(sock, lease):
+    # the compressed payload fills a pooled lease in place
+    got = 0
+    mv = lease.mv
+    while got < len(mv):
+        got += sock.recv_into(mv[got:])
+    return mv

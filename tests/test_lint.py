@@ -210,6 +210,102 @@ def test_tracing_module_is_under_the_hot_alloc_screen():
     assert not result.findings, result.findings
 
 
+def test_hot_alloc_covers_the_codec_hot_path_fixtures():
+    """ISSUE 9 satellite: the wire-compression codec is hot-path
+    territory — the fixture pair pins that hot-alloc keeps flagging
+    per-frame allocation idioms inside compress/decompress code and
+    passes the sanctioned lease-staging / .data.cast("B") /
+    recv_into patterns."""
+    bad = FIXTURES / "codec_hot_path_bad.py"
+    good = FIXTURES / "codec_hot_path_good.py"
+    flagged = run_lint(paths=[bad], checkers=["hot-alloc"], use_allowlist=False)
+    tags = {f.message.split("]")[0].lstrip("[") for f in flagged.findings}
+    assert {"to_bytes-call", "tobytes", "raw-recv", "bytes-materialize"} <= tags, (
+        flagged.findings
+    )
+    clean = run_lint(paths=[good], checkers=["hot-alloc"], use_allowlist=False)
+    assert not clean.findings, clean.findings
+
+
+def test_wire_protocol_checker_verifies_codec_opcode_both_ways():
+    """ISSUE 9 satellite: the codec-negotiation opcode ('Z') must stay
+    wired on both sides — client sender in tcp.py, server dispatch-
+    table entry in evloop.py — or tier-1 fails before any peer sees a
+    runtime protocol error."""
+    import ast
+
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
+    tree = ast.parse(tcp.read_text())
+    assert any(
+        isinstance(n, ast.Assign)
+        and isinstance(n.targets[0], ast.Name)
+        and n.targets[0].id == "_OP_CODEC"
+        for n in tree.body
+    ), "_OP_CODEC opcode constant missing from tcp.py"
+    result = run_lint(paths=[tcp, evloop], checkers=["wire-protocol"])
+    assert not result.findings, result.findings
+
+
+def test_blocking_checker_reaches_the_codec_decode_path():
+    """ISSUE 9 satellite: the compressed-payload decode runs inside the
+    stream reader's drain (TcpStreamReader -> _recv_payload ->
+    decode_payload -> codec decompress), so a sleep smuggled into a
+    decompressor must flag through the same name-based graph — and the
+    REAL codec module must scan clean from that graph."""
+    import textwrap
+
+    path = FIXTURES / "_tmp_codec_decode_sleep.py"
+    path.write_text(textwrap.dedent("""
+        import time
+
+
+        def batches_from_queue(queue, batch_size):
+            pop = getattr(queue, "get_batch_stream", None) or queue.get_batch
+            while True:
+                items = pop(batch_size, timeout=0.01)
+                if not items:
+                    return
+                yield items
+
+
+        class StreamReader:
+            def get_batch_stream(self, max_items, timeout=None):
+                return [decode_payload(b) for b in self._bufs]
+
+
+        def decode_payload(buf):
+            return _decode_compressed(buf)
+
+
+        def _decode_compressed(buf):
+            return SlowCodec().decompress(buf, bytearray(64))
+
+
+        class SlowCodec:
+            def decompress(self, src, dst):
+                time.sleep(0.001)  # must flag: stall inside the drain
+                return None
+    """))
+    try:
+        result = run_lint(paths=[path], checkers=["blocking-hot-path"])
+        hits = [
+            f
+            for f in result.findings
+            if "time.sleep" in f.message and "decompress" in f.message
+        ]
+        assert hits, result.findings
+    finally:
+        path.unlink()
+    # ...and the REAL decode path (batcher -> tcp stream reader ->
+    # codec) is inside the audited set with no findings
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    codec = REPO_ROOT / "psana_ray_tpu" / "transport" / "codec.py"
+    batcher = REPO_ROOT / "psana_ray_tpu" / "infeed" / "batcher.py"
+    real = run_lint(paths=[tcp, codec, batcher], checkers=["blocking-hot-path"])
+    assert not real.findings, real.findings
+
+
 def test_wire_protocol_checker_verifies_anchor_opcode_both_ways():
     """The clock-anchor opcode ('A', ISSUE 4) must stay wired on both
     sides: deleting either the client sender (tcp.py) or the server

@@ -551,10 +551,22 @@ def main():
         from psana_ray_tpu.lint import run_lint
 
         _lint = run_lint()
+        _counts = _lint.counts_by_checker()
         extras["lint"] = {
             "clean": _lint.ok,
             "findings_total": len(_lint.findings),
-            "counts_by_checker": _lint.counts_by_checker(),
+            "counts_by_checker": _counts,
+            # the ISSUE 10 flow layer called out separately: per-analysis
+            # finding counts ride the bench trajectory so a dialogue/
+            # lockset/leak regression shows up next to the fps rows
+            "flow_analyses": {
+                name: _counts.get(name, 0)
+                for name in (
+                    "protocol-dialogue",
+                    "lockset-inference",
+                    "resource-flow",
+                )
+            },
             "files_scanned": _lint.files_scanned,
             "duration_s": round(_lint.duration_s, 3),
         }

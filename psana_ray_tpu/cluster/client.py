@@ -1042,10 +1042,15 @@ class ClusterClient:
 
     # -- lifecycle ---------------------------------------------------------
     def disconnect(self):
-        if self._hb_stop is not None:
-            self._hb_stop.set()
-        if self._hb_thread is not None:
-            self._hb_thread.join(timeout=2.0)
+        with self._lock:
+            # snapshot under the lock (_ensure_joined installs these
+            # there); the set/join runs outside it so the heartbeat
+            # thread can finish its in-flight RPC without deadlocking
+            hb_stop, hb_thread = self._hb_stop, self._hb_thread
+        if hb_stop is not None:
+            hb_stop.set()
+        if hb_thread is not None:
+            hb_thread.join(timeout=2.0)
         with self._lock:
             session, self._session = self._session, None
             clients, self._clients = dict(self._clients), {}

@@ -44,18 +44,28 @@ def run_lint(
     checkers: Optional[Sequence[str]] = None,
     use_allowlist: bool = True,
     allowlist: Optional[Sequence[Allow]] = None,
+    use_cache: bool = False,
 ) -> LintResult:
     """Run the registry (or a named subset) over ``paths`` (default: the
     package + bench.py). Allowlist rot is reported only on full-registry,
     full-tree runs — a partial run legitimately leaves other checkers'
     entries unused. ``duration_s`` covers the WHOLE run — file reading
-    and parsing included, since that dominates — so the <5 s budget in
-    tier-1 and the bench artifact measure what an operator actually
-    waits for."""
+    and parsing included — so the budget in tier-1 and the bench
+    artifact measure what an operator actually waits for.
+    ``use_cache=True`` reuses parses across runs via the content-keyed
+    (sha256) cache in ``.lint_cache/`` (the CLI default; library
+    callers opt in)."""
     import time
 
     t0 = time.perf_counter()
-    index = ProjectIndex(paths if paths is not None else default_target_files())
+    cache = None
+    if use_cache:
+        from psana_ray_tpu.lint.cache import ParseCache
+
+        cache = ParseCache()
+    index = ProjectIndex(
+        paths if paths is not None else default_target_files(), cache=cache
+    )
     if checkers is None:
         selected = [REGISTRY[name] for name in sorted(REGISTRY)]
     else:

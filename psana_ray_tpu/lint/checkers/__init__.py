@@ -20,3 +20,5 @@ from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
     threads,
     wire,
 )
+# the flow layer (ISSUE 10) registers through the same import contract
+import psana_ray_tpu.lint.flow  # noqa: F401,E402  (import = register)

@@ -103,7 +103,12 @@ def _callees(node: ast.AST) -> Set[str]:
 def _sleep_names(fi) -> Tuple[Set[str], Set[str]]:
     """(module aliases for `time`, bare names bound to `time.sleep`) —
     `from time import sleep` / `import time as t` must not make the
-    stall idiom invisible."""
+    stall idiom invisible. Memoized per FileIndex: this walks the whole
+    file and is asked once per REACHABLE function (ISSUE 10 measured it
+    dominating the checker on the big transport modules)."""
+    cached = getattr(fi, "_sleep_names_memo", None)
+    if cached is not None:
+        return cached
     time_aliases, bare = {"time"}, set()
     for node in ast.walk(fi.tree):
         if isinstance(node, ast.Import):
@@ -114,6 +119,7 @@ def _sleep_names(fi) -> Tuple[Set[str], Set[str]]:
             for alias in node.names:
                 if alias.name == "sleep":
                     bare.add(alias.asname or "sleep")
+    fi._sleep_names_memo = (time_aliases, bare)
     return time_aliases, bare
 
 

@@ -89,9 +89,12 @@ def register(cls):
 
 
 class FileIndex:
-    """One parsed target file, shared by every checker in a run."""
+    """One parsed target file, shared by every checker in a run.
+    ``cache`` (a :class:`psana_ray_tpu.lint.cache.ParseCache`) carries
+    the parse across RUNS; within a run this object is already the
+    parse-once guarantee."""
 
-    def __init__(self, path):
+    def __init__(self, path, cache=None):
         self.path = pathlib.Path(path)
         try:
             self.rel = self.path.resolve().relative_to(REPO_ROOT).as_posix()
@@ -99,7 +102,12 @@ class FileIndex:
             self.rel = self.path.as_posix()
         self.source = self.path.read_text()
         self.lines = self.source.splitlines()
-        self.tree = ast.parse(self.source, filename=str(self.path))
+        tree = cache.get(self.path, self.rel, self.source) if cache else None
+        if tree is None:
+            tree = ast.parse(self.source, filename=str(self.path))
+            if cache is not None:
+                cache.put(self.path, self.rel, self.source, tree)
+        self.tree = tree
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
 
     def line(self, lineno: int) -> str:
@@ -137,17 +145,82 @@ def default_target_files() -> List[pathlib.Path]:
     return files
 
 
+# files the CROSS-FILE checkers anchor at; an incremental run always
+# carries them so a subset scan cannot fabricate findings:
+# - transport/tcp.py + transport/evloop.py: wire-protocol and
+#   protocol-dialogue need both sides or every sent opcode looks
+#   undispatched;
+# - infeed/batcher.py + infeed/fanin.py: blocking-hot-path's drain-loop
+#   roots live there, and its root-resolution rot guard (rightly)
+#   refuses to run silently uncovered on a >10-file scan
+PROTOCOL_COMPANIONS = (
+    "psana_ray_tpu/transport/tcp.py",
+    "psana_ray_tpu/transport/evloop.py",
+)
+INCREMENTAL_COMPANIONS = PROTOCOL_COMPANIONS + (
+    "psana_ray_tpu/infeed/batcher.py",
+    "psana_ray_tpu/infeed/fanin.py",
+)
+
+
+def changed_target_files(ref: str) -> List[pathlib.Path]:
+    """The default-target files touched since ``ref`` — the diff runs
+    from ``merge-base(ref, HEAD)`` to the working tree (so a branch
+    merely BEHIND ``ref`` does not drag upstream-only changes into the
+    incremental run), plus untracked files, ALWAYS including the
+    protocol companion pair when anything is selected. Raises
+    RuntimeError when git cannot answer (bad ref, not a checkout) —
+    the CLI turns that into a usage error, never a silent full run."""
+    import subprocess
+
+    def _git(cmd: List[str]) -> str:
+        try:
+            proc = subprocess.run(
+                cmd, cwd=REPO_ROOT, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            # no git binary / hung git must stay a usage error, not a
+            # traceback out of the CLI
+            raise RuntimeError(f"{' '.join(cmd)} failed: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} failed: {proc.stderr.strip() or proc.returncode}"
+            )
+        return proc.stdout
+
+    base = _git(["git", "merge-base", ref, "HEAD"]).strip()
+    names: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "-z", base, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+    ):
+        names.update(n for n in _git(cmd).split("\0") if n)
+    targets = {f.resolve(): f for f in default_target_files()}
+    selected = []
+    for name in sorted(names):
+        resolved = (REPO_ROOT / name).resolve()
+        if resolved in targets:
+            selected.append(targets[resolved])
+    if selected:
+        chosen = {p.resolve() for p in selected}
+        for rel in INCREMENTAL_COMPANIONS:
+            companion = REPO_ROOT / rel
+            if companion.exists() and companion.resolve() not in chosen:
+                selected.append(companion)
+    return selected
+
+
 class ProjectIndex:
     """Parse-once view of the target files. A file that fails to parse
     becomes a ``parse`` finding (syntax errors are the most static bug
     of all) instead of aborting the run."""
 
-    def __init__(self, paths: Sequence):
+    def __init__(self, paths: Sequence, cache=None):
         self.files: List[FileIndex] = []
         self.parse_findings: List[Finding] = []
         for p in paths:
             try:
-                self.files.append(FileIndex(p))
+                self.files.append(FileIndex(p, cache=cache))
             except SyntaxError as e:
                 self.parse_findings.append(
                     Finding(

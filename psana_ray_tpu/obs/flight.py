@@ -127,8 +127,13 @@ class FlightRecorder:
         the main thread (Python restriction) — the excepthook and
         programmatic triggers still work there."""
         os.makedirs(dump_dir, exist_ok=True)
-        self._dir = dump_dir
-        self._process = process or self._process
+        with self._lock:
+            # armed-state stores under the lock: dump()/snapshot() read
+            # them there, and arming must never race a dump into a
+            # half-set (dir, process) pair
+            self._dir = dump_dir
+            self._process = process or self._process
+            proc = self._process
         if signum is None:
             signum = getattr(signal, "SIGUSR2", None)
         if signum is not None and threading.current_thread() is threading.main_thread():
@@ -146,7 +151,7 @@ class FlightRecorder:
             # multithreaded wedge the black box exists for
             self._prev_threading_excepthook = threading.excepthook
             threading.excepthook = self._on_thread_exception
-        self.record("flight_installed", dir=dump_dir, process=self._process)
+        self.record("flight_installed", dir=dump_dir, process=proc)
         return self
 
     def uninstall(self) -> None:
@@ -164,7 +169,8 @@ class FlightRecorder:
         if self._prev_threading_excepthook is not None:
             threading.excepthook = self._prev_threading_excepthook
             self._prev_threading_excepthook = None
-        self._dir = None
+        with self._lock:
+            self._dir = None
 
     def _on_signal(self, signum, frame):
         self.record("sigusr2", signum=int(signum))
@@ -260,6 +266,10 @@ class FlightRecorder:
                 seq = self._dumps
                 events = list(self._events)
                 counts = dict(self._counts)
+                # armed-state snapshot: the file write below runs OUTSIDE
+                # the lock (record() callers must not block on disk), so
+                # take a coherent (dir, process) pair here
+                dump_dir, proc = self._dir, self._process
             try:
                 from psana_ray_tpu.obs.registry import MetricsRegistry
 
@@ -271,7 +281,7 @@ class FlightRecorder:
                 "trigger": trigger,
                 "host": self._host,
                 "pid": os.getpid(),
-                "process": self._process,
+                "process": proc,
                 "wall": time.time(),
                 "mono": time.monotonic(),
                 "event_counts": counts,
@@ -282,8 +292,8 @@ class FlightRecorder:
             if path is None:
                 stamp = time.strftime("%Y%m%d-%H%M%S")
                 path = os.path.join(
-                    self._dir,
-                    f"flight-{self._process or 'proc'}-{os.getpid()}-{stamp}-{seq}.json",
+                    dump_dir,
+                    f"flight-{proc or 'proc'}-{os.getpid()}-{stamp}-{seq}.json",
                 )
             with open(path, "w", encoding="utf-8") as f:
                 json.dump(doc, f, indent=1)

@@ -284,6 +284,7 @@ class Tracer:
             self._buf.append(self._line(t="p", **exchange))
 
     def _anchor_locked(self) -> None:
+        # guarded-by-caller: _lock
         self._buf.append(self._line(t="a", wall=time.time(), mono=time.monotonic()))
 
     @staticmethod
@@ -296,6 +297,7 @@ class Tracer:
             self._flush_locked()
 
     def _flush_locked(self) -> None:
+        # guarded-by-caller: _lock
         if self._f is None or not self._buf:
             self._buf = self._buf if self._f is not None else []
             return
@@ -310,6 +312,7 @@ class Tracer:
             self._close_locked()
 
     def _close_locked(self) -> None:
+        # guarded-by-caller: _lock
         if self._f is not None:
             self._anchor_locked()
             self._flush_locked()

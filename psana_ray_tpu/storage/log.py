@@ -139,10 +139,17 @@ class SegmentLog:
         next_offset = 0
         for fname in names:
             base = parse_base_offset(fname)
-            seg = Segment.open_existing(os.path.join(self.dir, fname), base)
             if not self._segments:
                 next_offset = base
-            seg_next, seg_torn = seg.scan(next_offset)
+            seg = Segment.open_existing(os.path.join(self.dir, fname), base)
+            try:
+                seg_next, seg_torn = seg.scan(next_offset)
+            except BaseException:
+                # a scan failure mid-recovery must not strand the
+                # mapping: close before propagating (the caller decides
+                # whether recovery as a whole survives)
+                seg.close()
+                raise
             torn = torn or seg_torn
             records += len(seg.index)
             next_offset = seg_next

@@ -923,21 +923,25 @@ def compress_encoded_parts(item, parts, codec, pool):
         clen = codec.compress(
             body, item.panels.dtype.itemsize, out.mv[: nbody - 16]
         )
+        if clen is None:
+            CODEC_STATS.expanded(codec.name)
+            out.release()
+            return parts, None
+        prefix = (
+            TAG_COMPRESSED
+            + _CPREFIX.pack(codec.codec_id, raw_len, len(head))
+            + head
+        )
+        CODEC_STATS.compressed(
+            raw_len, len(prefix) + clen, (time.monotonic() - t0) * 1000.0
+        )
     except BaseException:
+        # the except arm covers prefix assembly and the stats hooks
+        # too, not just the compress call — a raise anywhere between
+        # the lease and the hand-off below must not strand the staging
+        # buffer (the resource-flow checker walks exactly this window)
         out.release()
         raise
-    if clen is None:
-        out.release()
-        CODEC_STATS.expanded(codec.name)
-        return parts, None
-    prefix = (
-        TAG_COMPRESSED
-        + _CPREFIX.pack(codec.codec_id, raw_len, len(head))
-        + head
-    )
-    CODEC_STATS.compressed(
-        raw_len, len(prefix) + clen, (time.monotonic() - t0) * 1000.0
-    )
     return [prefix, out.mv[:clen]], out
 
 

@@ -869,8 +869,10 @@ class TcpQueueClient:
         ``(namespace, queue_name)``, get-or-creating it (``maxsize`` is
         used only on create; 0 = server default). Ray-GCS named-actor
         parity (reference ``shared_queue.py:33-38``, ``data_reader.py:20``)."""
-        self._binding = (namespace, queue_name, maxsize)
         with self._lock:
+            # binding stored under the lock: _reconnect reads it mid-
+            # replay and a racing rebind must never hand it a torn value
+            self._binding = (namespace, queue_name, maxsize)
             # no _retrying here: _reconnect itself replays the binding, so
             # the usual retry-the-exchange step would send a second OPEN
             try:
@@ -879,6 +881,7 @@ class TcpQueueClient:
                 self._reconnect(e)  # raises TransportClosed when it can't
 
     def _open_raw(self, namespace: str, queue_name: str, maxsize: int):
+        # guarded-by-caller: _lock
         ns, nm = namespace.encode(), queue_name.encode()
         self._sock.sendall(
             _OP_OPEN
@@ -1227,6 +1230,14 @@ class TcpQueueClient:
         with self._lock:
             if self._stream is not None:
                 return self._stream
+            if self._replay_args is not None:
+                # the server rejects 'M' on a replay connection (replay
+                # is pull-mode by design) and kills the connection; the
+                # protocol-dialogue checker pins this guard client-side
+                raise RuntimeError(
+                    "stream_open on a replay connection — replay is "
+                    "pull-mode; use a dedicated (non-replay) client"
+                )
             window = max(1, int(window))
 
             def _do():
@@ -1681,6 +1692,13 @@ class TcpQueueClient:
         the server accepted (a full queue truncates — retry the rest).
         Scatter-gather like :meth:`put`: N frames leave straight from
         their panel memory, never assembled into one request buffer."""
+        if self._stream is not None:
+            # a request/response opcode on the streamed socket would
+            # desync the push framing (the server kills anything but
+            # ack/BYE there) — route over the side channel like every
+            # other non-stream op; the protocol-dialogue checker pins
+            # this guard
+            return self._side_channel().put_batch(items)
 
         # the whole request assembles INSIDE the retried exchange so a
         # post-reconnect retry re-encodes under the renegotiated codec
@@ -1741,6 +1759,7 @@ class TcpQueueClient:
             pass
 
     def _status(self) -> bytes:
+        # guarded-by-caller: _lock
         st = _recv_exact(self._sock, 1)
         if st == _ST_CLOSED:
             raise TransportClosed(f"remote queue at {self.host}:{self.port} is closed")

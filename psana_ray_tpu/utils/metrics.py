@@ -103,7 +103,7 @@ class LatencyStats:
                     self._sorted = None
 
     def _sorted_view(self) -> List[float]:
-        # caller holds self._lock
+        # guarded-by-caller: _lock
         if self._sorted is None:
             self._sorted = sorted(self._samples)
         return self._sorted

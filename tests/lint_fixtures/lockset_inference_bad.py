@@ -1,0 +1,27 @@
+"""known-bad: inconsistent inferred locksets, no annotation needed.
+
+``_count`` is written under ``self._lock`` in ``add`` and read bare in
+``read`` — the PR 3/8 recurring class (correct until a scrape or
+teardown thread hits the bare access). ``_peak`` shows the annotation-
+assertion arm: declared ``guarded-by: _other_lock`` while every access
+holds ``_lock`` — the annotation names the wrong lock.
+"""
+
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other_lock = threading.Lock()
+        self._count = 0
+        self._peak = 0  # guarded-by: _other_lock
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+            if self._count > self._peak:
+                self._peak = self._count
+
+    def read(self):
+        return self._count  # bare: no lock held

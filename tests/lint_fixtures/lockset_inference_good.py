@@ -1,0 +1,37 @@
+"""sanctioned: every lockset pattern the checker must NOT flag.
+
+- ``_count``: every access holds ``self._lock``, either lexically or
+  through a ``# guarded-by-caller`` waiver;
+- ``capacity``: set once in ``__init__`` and only read after — Eraser's
+  init-phase exclusion (config fields need no lock);
+- ``_cv``: a Condition aliasing the lock — ``with self._cv:`` counts as
+  holding ``_lock``.
+"""
+
+import threading
+
+
+class ConsistentCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._count = 0
+        self.capacity = 8
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def wait_nonzero(self):
+        with self._cv:
+            while self._count == 0:
+                self._cv.wait(0.1)
+            return self._count
+
+    def _bump_locked(self):
+        # guarded-by-caller: _lock
+        self._count += 1
+
+    def headroom(self):
+        with self._lock:
+            return self.capacity - self._count

@@ -1,0 +1,117 @@
+"""known-bad: client/server opcode dialogue with seeded desyncs.
+
+Two bug classes the protocol-dialogue checker must flag:
+
+1. ``_op_probe`` can answer ``_ST_NO`` (no payload) but the client's
+   ``probe()`` never branches on the status byte before reading the
+   4-byte depth — one NO answer and every later byte is misframed
+   (the seeded "server arm with no client handler" desync);
+2. ``probe()``/``subscribe()`` send opcodes the server kills on a
+   streamed connection without checking ``self._stream`` anywhere —
+   the replay-on-streamed class of kill.
+"""
+
+import struct
+
+_OP_PUT = b"P"
+_OP_PROBE = b"Q"
+_OP_SUB = b"M"
+_OP_ACK = b"K"
+_ST_OK = b"1"
+_ST_NO = b"0"
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("eof")
+        buf += chunk
+    return buf
+
+
+class _StreamState:
+    def __init__(self):
+        self.seq = 0
+
+
+class BadServerConn:
+    def __init__(self, sock, queue):
+        self._sock = sock
+        self.queue = queue
+        self.stream = None
+
+    def _dispatch(self):
+        op = _recv_exact(self._sock, 1)[0]
+        if self.stream is not None:
+            # a streamed connection carries only acks upstream
+            if op == _OP_ACK[0]:
+                self._op_ack()
+                return
+            raise ConnectionError("bad opcode on streamed connection")
+        name = _OPS.get(op)
+        if name is None:
+            raise ConnectionError("unknown opcode")
+        getattr(self, name)()
+
+    def _op_put(self):
+        item = _recv_exact(self._sock, 4)
+        ok = self.queue.put(item)
+        self._sock.sendall(_ST_OK if ok else _ST_NO)
+
+    def _op_probe(self):
+        if self.queue.empty():
+            self._sock.sendall(_ST_NO)  # reply arm with no client branch
+            return
+        self._sock.sendall(_ST_OK + struct.pack("<I", self.queue.depth()))
+
+    def _op_sub(self):
+        self.stream = _StreamState()
+
+    def _op_ack(self):
+        _recv_exact(self._sock, 8)
+
+
+_OPS = {
+    _OP_PUT[0]: "_op_put",
+    _OP_PROBE[0]: "_op_probe",
+    _OP_SUB[0]: "_op_sub",
+    _OP_ACK[0]: "_op_ack",
+}
+
+
+class BadClient:
+    def __init__(self, sock):
+        self._sock = sock
+        self._stream = None
+
+    def put(self, payload):
+        if self._stream is not None:
+            raise RuntimeError("puts are illegal on a streamed client")
+        self._sock.sendall(_OP_PUT + payload)
+        st = _recv_exact(self._sock, 1)
+        return st == _ST_OK
+
+    def probe(self):
+        # BUG: bare status read, then an unconditional payload read —
+        # and no stream guard anywhere on the call chain
+        self._sock.sendall(_OP_PROBE)
+        _recv_exact(self._sock, 1)
+        (depth,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+        return depth
+
+    def subscribe(self):
+        # BUG: not idempotent and not stream-guarded: a second call on a
+        # subscribed connection is killed server-side
+        self._sock.sendall(_OP_SUB)
+        self._stream = StreamReader(self)
+        return self._stream
+
+
+class StreamReader:
+    def __init__(self, client):
+        self._c = client
+
+    def ack(self, seq):
+        self._c._sock.sendall(_OP_ACK + struct.pack("<Q", seq))

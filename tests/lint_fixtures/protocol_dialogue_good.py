@@ -1,0 +1,113 @@
+"""sanctioned: the same wire dialogue with both sides matched.
+
+Every reply arm the server can emit has a client branch, and every
+opcode the server restricts to a mode is guarded by the client's mode
+attribute (redirect/raise at the entry) — the shape
+``transport/tcp.py`` / ``transport/evloop.py`` ship.
+"""
+
+import struct
+
+_OP_PUT = b"P"
+_OP_PROBE = b"Q"
+_OP_SUB = b"M"
+_OP_ACK = b"K"
+_ST_OK = b"1"
+_ST_NO = b"0"
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("eof")
+        buf += chunk
+    return buf
+
+
+class _StreamState:
+    def __init__(self):
+        self.seq = 0
+
+
+class GoodServerConn:
+    def __init__(self, sock, queue):
+        self._sock = sock
+        self.queue = queue
+        self.stream = None
+
+    def _dispatch(self):
+        op = _recv_exact(self._sock, 1)[0]
+        if self.stream is not None:
+            if op == _OP_ACK[0]:
+                self._op_ack()
+                return
+            raise ConnectionError("bad opcode on streamed connection")
+        name = _OPS.get(op)
+        if name is None:
+            raise ConnectionError("unknown opcode")
+        getattr(self, name)()
+
+    def _op_put(self):
+        item = _recv_exact(self._sock, 4)
+        ok = self.queue.put(item)
+        self._sock.sendall(_ST_OK if ok else _ST_NO)
+
+    def _op_probe(self):
+        if self.queue.empty():
+            self._sock.sendall(_ST_NO)
+            return
+        self._sock.sendall(_ST_OK + struct.pack("<I", self.queue.depth()))
+
+    def _op_sub(self):
+        self.stream = _StreamState()
+
+    def _op_ack(self):
+        _recv_exact(self._sock, 8)
+
+
+_OPS = {
+    _OP_PUT[0]: "_op_put",
+    _OP_PROBE[0]: "_op_probe",
+    _OP_SUB[0]: "_op_sub",
+    _OP_ACK[0]: "_op_ack",
+}
+
+
+class GoodClient:
+    def __init__(self, sock):
+        self._sock = sock
+        self._stream = None
+
+    def put(self, payload):
+        if self._stream is not None:
+            raise RuntimeError("puts are illegal on a streamed client")
+        self._sock.sendall(_OP_PUT + payload)
+        st = _recv_exact(self._sock, 1)
+        return st == _ST_OK
+
+    def probe(self):
+        if self._stream is not None:
+            raise RuntimeError("probes are illegal on a streamed client")
+        self._sock.sendall(_OP_PROBE)
+        st = _recv_exact(self._sock, 1)
+        if st != _ST_OK:  # NO answer carries no payload: stop here
+            return 0
+        (depth,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+        return depth
+
+    def subscribe(self):
+        if self._stream is not None:  # idempotent: first subscription wins
+            return self._stream
+        self._sock.sendall(_OP_SUB)
+        self._stream = StreamReader(self)
+        return self._stream
+
+
+class StreamReader:
+    def __init__(self, client):
+        self._c = client
+
+    def ack(self, seq):
+        self._c._sock.sendall(_OP_ACK + struct.pack("<Q", seq))

@@ -1,0 +1,47 @@
+"""known-bad: acquires that leak on a path the syntactic checkers miss.
+
+``leaky_decode`` DOES hand its lease to a known owner — on the happy
+path. The ``recv_into`` between acquire and hand-off can raise, and
+nothing releases on that edge: PR 9's corrupt-head shape, visible only
+to the CFG's exception edges. ``leaky_branch`` leaks on the untaken
+branch: one path returns the lease, the other falls off the end.
+``leaky_handler_branch`` releases only under a guard UNRELATED to the
+lease — the handler's other branch re-raises with the lease stranded.
+``leaky_alias`` takes a local alias of the VIEW: deriving ``.mv``
+moves no ownership, so both the exception and fall-through paths leak.
+"""
+
+
+def leaky_decode(pool, sock, n):
+    lease = pool.lease(n)
+    sock.recv_into(lease.mv)  # can raise: the lease is stranded
+    return decode_payload(lease.mv, lease=lease)
+
+
+def leaky_branch(pool, n, want_lease):
+    lease = pool.lease(n)
+    if want_lease:
+        return lease
+    # falls through: lease dropped to the GC backstop
+
+
+def leaky_handler_branch(pool, sock, n, flag):
+    lease = pool.lease(n)
+    try:
+        sock.recv_into(lease.mv)
+    except BaseException:
+        if flag:  # guard unrelated to the lease: the other branch leaks
+            lease.release()
+        raise
+    return decode_payload(lease.mv, lease=lease)
+
+
+def leaky_alias(pool, sock, n):
+    lease = pool.lease(n)
+    mv = lease.mv  # a view, not a hand-off: the obligation stays here
+    sock.recv_into(mv)
+    return bytes(mv[:n])
+
+
+def decode_payload(mv, lease=None):
+    return bytes(mv[:4])

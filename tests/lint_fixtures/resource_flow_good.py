@@ -1,0 +1,65 @@
+"""sanctioned: every acquire→release pattern the checker must accept.
+
+- except-release-reraise covering the whole acquire→hand-off window;
+- try/finally release;
+- hand-off to a collection the caller owns (``out.append(lease)``);
+- ``with`` management (context manager releases);
+- escape into an attribute (object-lifetime hand-off).
+"""
+
+
+def guarded_decode(pool, sock, n):
+    lease = pool.lease(n)
+    try:
+        sock.recv_into(lease.mv)
+        return decode_payload(lease.mv, lease=lease)
+    except BaseException:
+        lease.release()
+        raise
+
+
+def finally_release(pool, sock, n):
+    lease = pool.lease(n)
+    try:
+        sock.recv_into(lease.mv)
+        return bytes(lease.mv[:n])
+    finally:
+        lease.release()
+
+
+def staged(pool, n, out):
+    lease = pool.lease(n)
+    out.append(lease)
+    return len(out)
+
+
+def managed(pool, n):
+    lease = pool.lease(n)
+    with lease:
+        return bytes(lease.mv[:n])
+
+
+def liveness_guarded(pool, sock, n):
+    out = None
+    try:
+        out = pool.lease(n)
+        sock.recv_into(out.mv)
+    except BaseException:
+        if out is not None:  # branch on the lease's OWN liveness
+            out.release()
+        raise
+    return out
+
+
+class Holder:
+    def __init__(self, pool, n):
+        self._lease = pool.lease(n)
+
+    def attach(self, pool, n):
+        lease = pool.lease(n)
+        self._lease = lease  # object-lifetime hand-off
+        return self._lease
+
+
+def decode_payload(mv, lease=None):
+    return bytes(mv[:4])

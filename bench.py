@@ -892,6 +892,17 @@ def main():
         lambda: _bench_durability(extras, smoke),
     )
 
+    # ---------------- replication: survive the machine -------------------
+    # device-free (ISSUE 11): replication-on vs off A/B (the replicated
+    # ack floor's measured price) + the kill-coordinator-AND-delete-its-
+    # disk row whose `lost` MUST be 0, with the group state surviving
+    # the coordinator failover and replay serving the retained range
+    run_section(
+        wd,
+        "replication",
+        lambda: _bench_replication(extras, smoke),
+    )
+
     # ---------------- config 5: multi-detector fan-in --------------------
     # two independent sections: the kHz HOST demonstration must not lose
     # its number to a tunnel-bound device leg timing out (round-3 run:
@@ -2849,6 +2860,307 @@ def _bench_durability(extras, smoke=False):
                 proc.kill()
         shutil.rmtree(scratch, ignore_errors=True)
     extras["durability_kill_restart"] = row
+
+
+def _bench_replication(extras, smoke=False):
+    """Chain replication (ISSUE 11, no device):
+
+    - ``replication_overhead``: relay fps through one durable queue
+      server with replication OFF vs ON (owner + follower, the
+      replicated ack floor gating every producer ack) on
+      detector-native u16 frames — the measured price of surviving the
+      machine, not just the process.
+    - ``replication_kill_delete_disk``: the acceptance row — a
+      3-server replicated cluster under windowed load; mid-run the
+      COORDINATOR server is shut down AND its ``--durable_dir`` is
+      deleted. ``lost`` MUST read 0 (the promoted followers serve the
+      backlog), replay from=begin still serves a retained range, and
+      the consumer group's generation/drained state survives the
+      coordinator failover (a stale-generation commit stays fenced).
+    """
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    from psana_ray_tpu.cluster.client import ClusterClient
+    from psana_ray_tpu.cluster.hashring import partition_owner
+    from psana_ray_tpu.cluster.replication import ReplicationManager
+    from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
+    from psana_ray_tpu.storage import DurableRingBuffer, SegmentLog
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+    shape = (2, 32, 32) if smoke else (16, 352, 384)  # epix10k2M u16
+    n_frames = 16 if smoke else 80
+    seg_bytes = (1 << 22) if smoke else (1 << 26)
+    rng = np.random.default_rng(17)
+    pool16 = [rng.integers(0, 4096, size=shape, dtype=np.uint16) for _ in range(4)]
+    scratch = tempfile.mkdtemp(prefix="bench_repl_")
+
+    def free_port():
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def durable_factory(durable_dir):
+        def factory(ns, name, maxsize):
+            log_ = SegmentLog(
+                os.path.join(durable_dir, f"{ns}__{name}"),
+                segment_bytes=seg_bytes, fsync="none", name=f"{ns}/{name}",
+            )
+            return DurableRingBuffer(log_, maxsize=maxsize, name=f"{ns}__{name}")
+
+        return factory
+
+    def start_cluster(n, tag, group_store=False):
+        dirs = [os.path.join(scratch, f"{tag}{i}") for i in range(n)]
+        for d in dirs:
+            os.makedirs(d, exist_ok=True)
+        ports = [free_port() for _ in range(n)]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        servers = []
+        for i in range(n):
+            mgr = (
+                ReplicationManager(dirs[i], peers, peers[i])
+                if n > 1 else None
+            )
+            servers.append(
+                TcpQueueServer(
+                    host="127.0.0.1", port=ports[i], maxsize=256,
+                    queue_factory=durable_factory(dirs[i]),
+                    replication=mgr,
+                    group_store_path=(
+                        os.path.join(dirs[i], "groups.json")
+                        if group_store else None
+                    ),
+                ).serve_background()
+            )
+        return dirs, ports, peers, servers
+
+    # -- A/B: replication off vs on ---------------------------------------
+    def run_relay(replicated: bool):
+        n = 2 if replicated else 1
+        dirs, ports, peers, servers = start_cluster(
+            n, "ab_on" if replicated else "ab_off"
+        )
+        try:
+            qname = "ab_q"
+            for i in range(512):  # owner must be server 0 (where we dial)
+                if partition_owner(peers, f"ab_q{i}", 0) == peers[0]:
+                    qname = f"ab_q{i}"
+                    break
+            prod = TcpQueueClient(
+                "127.0.0.1", ports[0], namespace="b", queue_name=qname
+            )
+            cons = TcpQueueClient(
+                "127.0.0.1", ports[0], namespace="b", queue_name=qname
+            )
+
+            def produce():
+                for i in range(n_frames):
+                    rec = FrameRecord(0, i, pool16[i % 4], 9.5)
+                    if not prod.put_pipelined(
+                        rec, deadline=time.monotonic() + 120
+                    ):
+                        raise RuntimeError("producer starved out")
+                if not prod.flush_puts(deadline=time.monotonic() + 120):
+                    raise RuntimeError("put window never drained")
+                if not prod.put_wait(
+                    EndOfStream(total_events=n_frames), timeout=120
+                ):
+                    raise RuntimeError("EOS delivery timed out")
+
+            t = _threading.Thread(target=produce, daemon=True)
+            seen = 0
+            t0 = time.perf_counter()
+            t.start()
+            while True:
+                batch = cons.get_batch(16, timeout=10.0)
+                if not batch:
+                    break
+                if any(is_eos(x) for x in batch):
+                    seen += sum(0 if is_eos(x) else 1 for x in batch)
+                    break
+                seen += len(batch)
+            dt = time.perf_counter() - t0
+            t.join(timeout=10)
+            for c in (prod, cons):
+                try:
+                    c.disconnect()
+                except Exception:
+                    pass
+            if seen != n_frames:
+                raise RuntimeError(f"relay saw {seen}/{n_frames} frames")
+            return seen / dt
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    rows = []
+    for replicated in (False, True):
+        fps = run_relay(replicated)
+        rows.append({
+            "replication": "on" if replicated else "off",
+            "fps": round(fps, 1),
+        })
+        log(
+            f"replication [relay A/B, u16 {shape}, "
+            f"{'on: owner+follower, ack-floor gated' if replicated else 'off'}]: "
+            f"{fps:.0f} fps"
+        )
+    if rows[0]["fps"] > 0:
+        rows[1]["overhead_pct"] = round(
+            100.0 * (1 - rows[1]["fps"] / rows[0]["fps"]), 1
+        )
+        log(
+            f"replication: ack-floor overhead "
+            f"{rows[1]['overhead_pct']}% on {shape} u16 frames "
+            f"(every producer ack waits for the follower's log)"
+        )
+    extras["replication_overhead"] = rows
+
+    # -- acceptance row: kill the coordinator AND delete its disk ---------
+    P = 4
+    kd_frames = 24 if smoke else 120
+    dirs, ports, peers, servers = start_cluster(3, "kd", group_store=True)
+    prod_c = cons_c = None
+    row = {"produced": kd_frames, "lost": -1}
+    try:
+        prod_c = ClusterClient(
+            peers, queue_name="kdq", n_partitions=P, maxsize=256,
+            retain=512, reconnect_tries=1, reconnect_base_s=0.05,
+        )
+        cons_c = ClusterClient(
+            peers, queue_name="kdq", n_partitions=P, maxsize=256,
+            group="kdg", reconnect_tries=1, reconnect_base_s=0.05,
+        )
+        killed_t = {"t": None}
+        prod_err = {"err": None}
+
+        def produce():
+            try:
+                for i in range(kd_frames):
+                    rec = FrameRecord(0, i, pool16[i % 4], 9.5)
+                    if not prod_c.put_pipelined(
+                        rec, deadline=time.monotonic() + 120
+                    ):
+                        raise RuntimeError(f"producer gave up at frame {i}")
+                    if i == kd_frames // 3:
+                        killed_t["t"] = time.monotonic()
+                        servers[0].shutdown()
+                        shutil.rmtree(dirs[0], ignore_errors=True)
+                if not prod_c.flush_puts(time.monotonic() + 120):
+                    raise RuntimeError("producer flush timed out")
+                if not prod_c.put_wait(
+                    EndOfStream(0, -1, 1, 1), timeout=120
+                ):
+                    raise RuntimeError("EOS broadcast timed out")
+            except BaseException as e:  # noqa: BLE001 — reported below
+                prod_err["err"] = e
+
+        seen = []
+        t = _threading.Thread(target=produce, daemon=True)
+        t0 = time.perf_counter()
+        t.start()
+        eos = 0
+        reassign_latency = None
+        v0 = cons_c.partition_map.version
+        deadline = t0 + 600.0
+        while not eos and time.perf_counter() < deadline:
+            if prod_err["err"] is not None:
+                raise RuntimeError(
+                    "replication kill-row producer failed; frames were "
+                    "never sent, not lost"
+                ) from prod_err["err"]
+            for item in cons_c.get_batch_stream(32, timeout=0.5):
+                if is_eos(item):
+                    eos += 1
+                else:
+                    seen.append(item.event_idx)
+            if (
+                reassign_latency is None
+                and killed_t["t"] is not None
+                and cons_c.partition_map.version > v0
+            ):
+                reassign_latency = time.monotonic() - killed_t["t"]
+        t.join(timeout=30.0)
+        unique = set(seen)
+        lost = sorted(set(range(kd_frames)) - unique)
+        # the coordinator's group state survived the failover iff a
+        # stale-generation commit is still FENCED on the new coordinator
+        info = cons_c._rpc({"op": "info", "group": "kdg"})
+        stale = cons_c._rpc({
+            "op": "drained", "group": "kdg", "member": "bench-zombie",
+            "generation": int(info.get("generation", 0)) - 1,
+            "partition": 0,
+        })
+        # replay from=begin on the survivors: the retained range must
+        # still serve (the promoted followers hold the logs)
+        replayer = ClusterClient(
+            peers[1:], queue_name="kdq", n_partitions=P, maxsize=256,
+            reconnect_tries=1, reconnect_base_s=0.05,
+        )
+        replayed = set()
+        try:
+            replayer.replay_open(from_offset="begin", group="bench-audit")
+            empty = 0
+            while empty < 3:
+                batch = replayer.get_batch(64, timeout=1.0)
+                if batch:
+                    replayed |= {
+                        b.event_idx for b in batch if not is_eos(b)
+                    }
+                    empty = 0
+                else:
+                    empty += 1
+        finally:
+            replayer.disconnect()
+        row = {
+            "produced": kd_frames,
+            "consumed": len(unique),
+            "redelivered": len(seen) - len(unique),
+            "lost": len(lost),
+            "reassign_latency_s": (
+                round(reassign_latency, 3)
+                if reassign_latency is not None else None
+            ),
+            "group_generation": info.get("generation"),
+            "group_drained": len(info.get("drained", ())),
+            "stale_commit_fenced": bool(stale.get("fenced")),
+            "replay_served": len(replayed),
+        }
+        if lost:
+            raise RuntimeError(
+                f"replication kill+delete-disk LOST {len(lost)} frames: "
+                f"{lost[:10]}..."
+            )
+        log(
+            f"replication [kill coordinator + delete its durable_dir]: "
+            f"{row['lost']} lost (MUST be 0), "
+            f"{row['redelivered']} redelivered, reassign "
+            f"{row['reassign_latency_s']}s, group gen "
+            f"{row['group_generation']} with {row['group_drained']}/{P} "
+            f"drained survived (stale commit fenced="
+            f"{row['stale_commit_fenced']}), replay served "
+            f"{row['replay_served']} frame(s)"
+        )
+    finally:
+        for c in (prod_c, cons_c):
+            if c is not None:
+                try:
+                    c.disconnect()
+                except Exception:
+                    pass
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+        shutil.rmtree(scratch, ignore_errors=True)
+    extras["replication_kill_delete_disk"] = row
 
 
 def _bench_connection_scaling(extras, smoke=False):

@@ -149,6 +149,11 @@ class ClusterClient:
         )  # guarded-by: _lock
         self._dead: set = set()  # guarded-by: _lock
         self._clients: Dict[int, TcpQueueClient] = {}  # guarded-by: _lock
+        # partitions whose owner DIED (not merely moved): the next
+        # connection to the new owner sends 'Y' promote first, so a
+        # replica log there (ISSUE 11) is fenced + mounted as the live
+        # queue before OPEN touches it
+        self._promote_pending: set = set()  # guarded-by: _lock
         self._resend_pending: Dict[int, List[Any]] = {}  # guarded-by: _lock
         self._retained: Dict[int, deque] = {}  # guarded-by: _lock
         self._rr = 0  # round-robin put cursor  # guarded-by: _lock
@@ -428,7 +433,13 @@ class ClusterClient:
                 f"every cluster server is dead (last: {addr})"
             )
         FLIGHT.record("cluster_server_dead", server=addr)
-        self._apply_map(self._map.recompute(survivors))
+        new_map = self._map.recompute(survivors)
+        moved = new_map.moved_from(self._map)
+        self._apply_map(new_map)
+        # a DEATH-forced move lands on the rendezvous runner-up — the
+        # very server holding the partition's replica log when the
+        # cluster replicates: promote before first touch
+        self._promote_pending.update(moved)
         return True
 
     # -- per-partition plumbing -------------------------------------------
@@ -438,18 +449,59 @@ class ClusterClient:
         if c is None:
             addr = self._map.assignments[p]
             host, _, port = addr.rpartition(":")
-            c = TcpQueueClient(
-                host, int(port),
-                timeout_s=self._timeout_s,
-                namespace=self.namespace,
-                queue_name=partition_queue_name(self.queue_name, p),
-                maxsize=self._maxsize,
-                reconnect_tries=self._reconnect_tries,
-                reconnect_base_s=self._reconnect_base_s,
-                pool=self._pool,
-                put_window=self._put_window,
-                codec=self._codec,
-            )
+            qname = partition_queue_name(self.queue_name, p)
+            promote = p in self._promote_pending
+            if promote:
+                # failover landing: dial WITHOUT the binding, promote
+                # the replica log ('Y') so OPEN mounts the replicated
+                # backlog, THEN bind. An old server without the opcode
+                # answers protocol-error — degrade to a plain open
+                # (the partition starts empty there, as before ISSUE 11)
+                c = TcpQueueClient(
+                    host, int(port),
+                    timeout_s=self._timeout_s,
+                    maxsize=self._maxsize,
+                    reconnect_tries=self._reconnect_tries,
+                    reconnect_base_s=self._reconnect_base_s,
+                    pool=self._pool,
+                    put_window=self._put_window,
+                    codec=self._codec,
+                )
+                rng = None
+                try:
+                    try:
+                        rng = c.promote(self.namespace, qname)
+                    except TransportClosed:
+                        raise  # dead server, NOT a protocol answer
+                    except RuntimeError:
+                        pass  # pre-replication server: plain failover
+                    CLUSTER.promoted(served=rng is not None)
+                    FLIGHT.record(
+                        "replica_promote", partition=p, server=addr,
+                        served=rng is not None,
+                        **(rng or {}),
+                    )
+                    c.open(self.namespace, qname, self._maxsize)
+                except TransportClosed:
+                    # the new owner died mid-promotion: drop the
+                    # half-built client (pending stays set — the NEXT
+                    # owner gets its promote) and let failover run
+                    _close_quietly(c)
+                    raise
+                self._promote_pending.discard(p)
+            else:
+                c = TcpQueueClient(
+                    host, int(port),
+                    timeout_s=self._timeout_s,
+                    namespace=self.namespace,
+                    queue_name=qname,
+                    maxsize=self._maxsize,
+                    reconnect_tries=self._reconnect_tries,
+                    reconnect_base_s=self._reconnect_base_s,
+                    pool=self._pool,
+                    put_window=self._put_window,
+                    codec=self._codec,
+                )
             self._clients[p] = c
         return c  # deferred resend flushes in _with_failover, once per op
 

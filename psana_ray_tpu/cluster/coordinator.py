@@ -55,6 +55,13 @@ from typing import Dict, Optional
 # still reassign within seconds-not-minutes
 DEFAULT_SESSION_TIMEOUT_S = 10.0
 
+# leader-lease length for coordinator snapshot replication (ISSUE 11):
+# the acting coordinator holds this lease ON the follower registry it
+# syncs into; a failed-over coordinator can take over once it expires,
+# and a zombie's late sync is fenced by the holder check (plus per-group
+# generation monotonicity, which can never regress either way)
+DEFAULT_LEASE_TTL_S = 10.0
+
 
 class _Group:
     __slots__ = (
@@ -112,6 +119,14 @@ class GroupRegistry:
         self._groups: Dict[str, _Group] = {}  # guarded-by: _lock
         self._store_path = store_path
         self._dirty = False  # mutation since last persist  # guarded-by: _lock
+        # replication leader lease (ISSUE 11): (holder address, expiry
+        # mono) — who may sync snapshots INTO this registry
+        self._lease = ("", 0.0)  # guarded-by: _lock
+        # set-once hook (ReplicationManager.attach): called after any
+        # CLIENT mutation persists, NEVER on an absorbed sync (that
+        # would relay snapshots in a loop). Must be non-blocking — it
+        # runs under the registry lock (an Event.set).
+        self.on_mutate = None
         if store_path:
             self._load()
 
@@ -171,6 +186,18 @@ class GroupRegistry:
     # -- the RPC entry point ----------------------------------------------
     def handle(self, req: dict) -> dict:
         op = req.get("op")
+        if op in ("lease", "sync"):
+            # coordinator-replication control ops (ISSUE 11): group-less
+            # — they carry a holder address and (for sync) a whole
+            # snapshot. Absorbed syncs persist but never fire on_mutate
+            # (relaying a snapshot we were handed would loop).
+            with self._lock:
+                try:
+                    return self._control(op, req)
+                finally:
+                    if self._dirty:
+                        self._dirty = False
+                        self._persist()
         group = req.get("group")
         if not isinstance(group, str) or not group:
             return {"ok": False, "error": "missing group"}
@@ -182,6 +209,11 @@ class GroupRegistry:
                 if self._dirty:
                     self._dirty = False
                     self._persist()
+                    if self.on_mutate is not None:
+                        try:
+                            self.on_mutate()
+                        except Exception:  # a broken hook must not kill RPCs
+                            pass
 
     def _dispatch(self, op, group, member, req: dict) -> dict:
         # guarded-by-caller: _lock
@@ -263,6 +295,83 @@ class GroupRegistry:
         if op == "info":
             return self._state(g, ok=True)
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # -- replication control ops (ISSUE 11) --------------------------------
+    def _control(self, op: str, req: dict) -> dict:
+        """``lease``: acquire/renew the leader lease for ``holder``
+        (refused while another holder's lease is live). ``sync``: absorb
+        the holder's snapshot of group CONTROL state — generation /
+        n_partitions / drained / offsets, never member leases (liveness
+        is local, members rejoin after a failover exactly as after a
+        restart) — monotonically per group, so a zombie's late snapshot
+        can never regress the fence."""
+        # guarded-by-caller: _lock
+        holder = str(req.get("holder") or "")
+        if not holder:
+            return {"ok": False, "error": "missing holder"}
+        now = time.monotonic()
+        cur, expires = self._lease
+        if cur and cur != holder and expires > now:
+            return {
+                "ok": False, "fenced": True, "holder": cur,
+                "expires_in_s": round(expires - now, 3),
+            }
+        if cur != holder:
+            try:
+                from psana_ray_tpu.obs.flight import FLIGHT
+
+                FLIGHT.record(
+                    "lease_transfer", prev=cur or None, holder=holder
+                )
+            except Exception:  # obs optional: the registry stays stdlib-safe
+                pass
+        ttl = float(req.get("ttl") or DEFAULT_LEASE_TTL_S)
+        self._lease = (holder, now + ttl)
+        if op == "lease":
+            return {"ok": True, "holder": holder}
+        absorbed = 0
+        for name, st in (req.get("groups") or {}).items():
+            try:
+                gen = int(st.get("generation", 0))
+            except (TypeError, ValueError, AttributeError):
+                continue
+            g = self._groups.get(name)
+            if g is None:
+                g = self._groups[name] = _Group()
+            elif gen < g.generation:
+                continue  # a stale snapshot never regresses the fence
+            g.generation = gen
+            g.n_partitions = int(st.get("n_partitions", g.n_partitions) or 0)
+            g.drained = {int(p) for p in st.get("drained", ())}
+            g.offsets = {
+                int(p): int(o) for p, o in (st.get("offsets") or {}).items()
+            }
+            if not g.members and g.drained and not (
+                g.n_partitions and len(g.drained) >= g.n_partitions
+            ):
+                # same shape as a disk recovery: an absorbed group with
+                # a PARTIAL drain set and no members is "awaiting
+                # rejoin" — the new-epoch wipe must not fire on it
+                g.recovered_pending = True
+            absorbed += 1
+        if absorbed:
+            self._dirty = True
+        return {"ok": True, "absorbed": absorbed}
+
+    def snapshot_groups(self) -> dict:
+        """The replicable control state — exactly what ``sync`` absorbs
+        and :meth:`_persist` writes (member leases are liveness, not
+        state)."""
+        with self._lock:
+            return {
+                name: {
+                    "generation": g.generation,
+                    "n_partitions": g.n_partitions,
+                    "drained": sorted(g.drained),
+                    "offsets": {str(p): o for p, o in g.offsets.items()},
+                }
+                for name, g in self._groups.items()
+            }
 
     # -- internals (caller holds _lock) -----------------------------------
     def _sweep(self, g: _Group) -> None:

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 
 def partition_queue_name(queue_name: str, partition: int) -> str:
@@ -50,6 +50,48 @@ def partition_owner(
     if not servers:
         raise ValueError("no live servers to place partitions on")
     return max(servers, key=lambda s: (_score(s, queue_name, partition), s))
+
+
+def ranked_owners(
+    servers: Sequence[str], queue_name: str, partition: int
+) -> Tuple[str, ...]:
+    """Every server ranked by rendezvous score for ``(queue_name,
+    partition)``, best first — the replication CHAIN (ISSUE 11): rank 0
+    is the owner, rank 1 its follower, and when rank 0 dies the
+    recomputed map hands the partition to rank 1 — exactly the server
+    already holding the replica. A server mounting the partition
+    replicates to the NEXT rank after itself, so the chain re-extends
+    after every promotion (rank 1 serves, rank 2 becomes the follower)."""
+    return tuple(
+        sorted(
+            dict.fromkeys(servers),
+            key=lambda s: (_score(s, queue_name, partition), s),
+            reverse=True,
+        )
+    )
+
+
+def partition_follower(
+    servers: Sequence[str], queue_name: str, partition: int
+) -> Optional[str]:
+    """The partition's replica holder: the rendezvous runner-up (None on
+    a single-server set — nothing to chain to)."""
+    ranked = ranked_owners(servers, queue_name, partition)
+    return ranked[1] if len(ranked) > 1 else None
+
+
+def next_in_chain(
+    servers: Sequence[str], self_addr: str, queue_name: str, partition: int
+) -> Optional[str]:
+    """Where ``self_addr`` should replicate ``(queue_name, partition)``
+    if it mounts it: the next server after itself in the rendezvous
+    ranking (None when last in the chain or not a chain member)."""
+    ranked = ranked_owners(servers, queue_name, partition)
+    try:
+        i = ranked.index(self_addr)
+    except ValueError:
+        return None
+    return ranked[i + 1] if i + 1 < len(ranked) else None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +141,11 @@ class PartitionMap:
         return tuple(
             p for p, s in sorted(self.assignments.items()) if s == server
         )
+
+    def follower_of(self, partition: int) -> Optional[str]:
+        """The partition's replica holder under this map's live set —
+        the rendezvous runner-up (ISSUE 11; None on one server)."""
+        return partition_follower(self.servers, self.queue_name, partition)
 
     def moved_from(self, prev: "PartitionMap") -> Tuple[int, ...]:
         """Partitions whose owner differs from ``prev`` — the rebalance

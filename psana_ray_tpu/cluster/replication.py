@@ -1,0 +1,967 @@
+"""Chain-replicated partition logs + coordinator leases (ISSUE 11).
+
+PR 8 made ``kill -9`` lose nothing — but the segment log still dies with
+its disk, and the coordinator's persisted group state was unreplicated
+(failover = rejoin). This module makes the cluster survive the MACHINE:
+
+- **Partition-log chain replication** (van Renesse & Schneider, OSDI
+  2004 — PAPERS.md): each durable partition's segment log ships to a
+  follower server over a dedicated replication link — the windowed-PUT
+  shape ('V' replica-append, cumulative acks) with the negotiated wire
+  codec ('Z'), so the replication link is compressed exactly like any
+  other link. The chain IS the rendezvous ranking
+  (:func:`~psana_ray_tpu.cluster.hashring.ranked_owners`): rank 0 owns,
+  rank 1 holds the replica, and when rank 0 dies the recomputed
+  partition map hands the partition to rank 1 — the server already
+  holding the bytes. Promotion ('Y') fences the replica log against a
+  zombie owner and mounts it as the live durable queue; the new owner
+  then re-extends the chain to rank 2.
+- **Replicated ack floor**: the owner's event loop holds a producer's
+  put reply until the follower has logged that record
+  (:meth:`ReplicationSender.reached`) — an acked frame survives the
+  owner's DISK, not just its process. A dead follower link degrades
+  loudly after a grace window (breadcrumb + acks flow again) instead of
+  wedging producers; the producer-side retained resend (PR 7) still
+  bounds the exposure.
+- **Coordinator leader lease**: every group mutation pushes the
+  :class:`~psana_ray_tpu.cluster.coordinator.GroupRegistry` control
+  snapshot (generation / drained / offsets — never member leases) to
+  the next live peer over the existing 'N' RPC, under a leader lease
+  the receiving registry enforces. Coordinator failover is therefore
+  promotion, not amnesia: the failed-over registry continues the same
+  generations, so stale-generation commits stay fenced.
+
+Wiring: construct a :class:`ReplicationManager` (``queue_server
+--replicate_peers ... --advertise ...``) and hand it to
+``TcpQueueServer(replication=...)``. Everything else is hooks: the
+server's ``open_named`` mounts senders / promotes replicas, the event
+loop routes 'H'/'V'/'Y' and parks producer acks on the floor.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from psana_ray_tpu.cluster.hashring import next_in_chain
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.storage.log import (
+    DEFAULT_FSYNC_BATCH_N,
+    DEFAULT_RETAIN_SEGMENTS,
+    DEFAULT_SEGMENT_BYTES,
+    FSYNC_BATCH,
+    SegmentLog,
+)
+from psana_ray_tpu.transport.codec import (
+    available_codecs,
+    encode_for_wire as _wire_encode,
+    get_codec,
+    payload_nbytes as _parts_nbytes,
+)
+from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.tcp import (
+    _OP_BYE,
+    _OP_CODEC,
+    _OP_REPL_APPEND,
+    _OP_REPL_OPEN,
+    _REPL_NO_FLOOR,
+    _ST_OK,
+    _recv_exact,
+    _sendmsg_all,
+)
+from psana_ray_tpu.utils.bufpool import BufferPool
+
+# appends in flight on one replication link before the shipper blocks
+# on acks — the same window shape as the producer's pipelined 'W' puts
+DEFAULT_REPL_WINDOW = 32
+# how long a dead follower link may gate producer acks before the owner
+# degrades to unreplicated (loudly): availability over the replica
+# guarantee, with the producer-side retained resend as the backstop
+DEFAULT_DEGRADE_AFTER_S = 5.0
+# piggybacked committed-floor commits on the replica are throttled to
+# this stride (each commit is an fsync'd sidecar line); promotion
+# commits the exact latest floor, so the stride only costs <= stride
+# extra duplicates on failover
+FLOOR_COMMIT_STRIDE = 32
+
+
+def parse_partition(queue_name: str) -> Tuple[str, int]:
+    """(base queue, partition) off the ``q#pN`` convention; a plain
+    (non-partitioned) durable queue chains as partition 0 of itself."""
+    base, sep, tail = queue_name.rpartition("#p")
+    if sep and tail.isdigit():
+        return base, int(tail)
+    return queue_name, 0
+
+
+class ReplicationTelemetry:
+    """Obs source ``replication``: link/ship/ack counters plus the lag
+    gauge (records appended on owners but not yet follower-acked)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registered = False  # guarded-by: _lock
+        self.links_opened = 0  # guarded-by: _lock
+        self.link_reconnects = 0  # guarded-by: _lock
+        self.records_shipped = 0  # guarded-by: _lock
+        self.bytes_shipped = 0  # guarded-by: _lock
+        self.degrades = 0  # guarded-by: _lock
+        self.restores = 0  # guarded-by: _lock
+        self.fenced_links = 0  # guarded-by: _lock
+        self.replica_appends = 0  # follower-side records logged  # guarded-by: _lock
+        self.promotes = 0  # follower-side promotions served  # guarded-by: _lock
+        self.coord_syncs = 0  # guarded-by: _lock
+        self.lease_denied = 0  # guarded-by: _lock
+        self._senders: list = []  # live senders, for the lag gauge  # guarded-by: _lock
+
+    def ensure_registered(self):
+        with self._lock:
+            if self._registered:
+                return
+            self._registered = True
+        try:
+            from psana_ray_tpu.obs import MetricsRegistry
+
+            MetricsRegistry.default().register("replication", self)
+        except Exception:  # obs optional: replication must work without it
+            pass
+
+    def track(self, sender):
+        self.ensure_registered()
+        with self._lock:
+            self._senders.append(sender)
+
+    def untrack(self, sender):
+        with self._lock:
+            try:
+                self._senders.remove(sender)
+            except ValueError:
+                pass
+
+    def link_opened(self):
+        with self._lock:
+            self.links_opened += 1
+
+    def reconnected(self):
+        with self._lock:
+            self.link_reconnects += 1
+
+    def shipped(self, records: int, nbytes: int):
+        with self._lock:
+            self.records_shipped += records
+            self.bytes_shipped += nbytes
+
+    def degraded(self):
+        with self._lock:
+            self.degrades += 1
+
+    def restored(self):
+        with self._lock:
+            self.restores += 1
+
+    def fenced(self):
+        with self._lock:
+            self.fenced_links += 1
+
+    def replica_appended(self):
+        self.ensure_registered()
+        with self._lock:
+            self.replica_appends += 1
+
+    def promoted(self):
+        self.ensure_registered()
+        with self._lock:
+            self.promotes += 1
+
+    def coord_synced(self):
+        with self._lock:
+            self.coord_syncs += 1
+
+    def lease_was_denied(self):
+        with self._lock:
+            self.lease_denied += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            lag = 0
+            for s in self._senders:
+                lag += s.lag()
+            return {
+                "links_opened": self.links_opened,
+                "link_reconnects": self.link_reconnects,
+                "records_shipped": self.records_shipped,
+                "bytes_shipped": self.bytes_shipped,
+                "lag_records": lag,
+                "degrades": self.degrades,
+                "restores": self.restores,
+                "fenced_links": self.fenced_links,
+                "replica_appends": self.replica_appends,
+                "promotes": self.promotes,
+                "coord_syncs": self.coord_syncs,
+                "lease_denied": self.lease_denied,
+            }
+
+    # obs registry source protocol
+    def snapshot(self) -> dict:
+        return self.stats()
+
+
+REPL = ReplicationTelemetry()
+
+
+class ReplicaRefused(RuntimeError):
+    """The follower refused the subscription or an append: no durable
+    backing there, the queue is mounted live on it, or the replica was
+    PROMOTED — the fencing answer a zombie owner must treat as "stop
+    replicating", never retry through."""
+
+
+class _ReplicaSub:
+    """One link's subscription state (the client-side replica-mode
+    object): the follower's log tail at subscribe time."""
+
+    __slots__ = ("tail",)
+
+    def __init__(self, tail: int):
+        self.tail = tail
+
+
+class ReplicaLink:
+    """Client half of one replication chain hop: a dedicated connection
+    to the follower, subscribed ('H') to one replica log, shipping
+    pipelined replica-appends ('V') and reading cumulative acks. NOT
+    thread-safe — owned by exactly one :class:`ReplicationSender`
+    thread."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        namespace: str,
+        queue_name: str,
+        codec: Optional[str] = None,
+        pool: Optional[BufferPool] = None,
+        timeout_s: float = 10.0,
+    ):
+        self.host, self.port = host, port
+        self._ns, self._nm = namespace, queue_name
+        self._timeout_s = timeout_s
+        self._pool = pool if pool is not None else BufferPool.default()
+        self._codec = None  # negotiated codec object (None = raw)
+        if codec == "auto":
+            self._codec_names = available_codecs() or None
+        elif codec:
+            get_codec(codec)  # fail fast on unknown names
+            self._codec_names = [codec]
+        else:
+            self._codec_names = None
+        self._stream: Optional[_ReplicaSub] = None  # replica-mode state
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def subscribe(self) -> int:
+        """One 'H' exchange: bind this connection to the follower's
+        replica log and learn its tail (where shipping resumes).
+        Idempotent. Raises :class:`ReplicaRefused` on '0'."""
+        if self._stream is not None:
+            return self._stream.tail
+        if self._codec_names:
+            self._negotiate()
+        ns, nm = self._ns.encode(), self._nm.encode()
+        self._sock.sendall(
+            _OP_REPL_OPEN
+            + struct.pack("<H", len(ns)) + ns
+            + struct.pack("<H", len(nm)) + nm
+        )
+        st = _recv_exact(self._sock, 1)
+        if st != _ST_OK:
+            raise ReplicaRefused(
+                f"follower {self.host}:{self.port} refused the replica "
+                f"subscription for {self._ns}/{self._nm} ({st!r}) — no "
+                f"durable backing there, queue mounted live, or already "
+                f"promoted"
+            )
+        (tail,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+        self._stream = _ReplicaSub(tail)
+        return tail
+
+    def _negotiate(self) -> None:
+        """'Z' on the replication link: the follower picks a codec and
+        the shipped segment records travel compressed — the PR 9
+        "compress the durable segment log" follow-up, closed for the
+        link. Degrades to raw on any refusal, never fails the link."""
+        names = ",".join(self._codec_names).encode()
+        self._sock.sendall(_OP_CODEC + struct.pack("<H", len(names)) + names)
+        st = _recv_exact(self._sock, 1)
+        if st != _ST_OK:
+            self._codec = None
+            return
+        (n,) = struct.unpack("<H", _recv_exact(self._sock, 2))
+        name = _recv_exact(self._sock, n).decode()
+        try:
+            self._codec = get_codec(name)
+        except ValueError:
+            self._codec = None
+
+    def ship(self, offset: int, floor: int, item) -> int:
+        """Pipelined 'V' append at an explicit log offset with the
+        owner's committed floor piggybacked; acks are read separately
+        (:meth:`read_ack`). Returns the wire payload size."""
+        if self._stream is None:
+            self.subscribe()
+        parts, clease = _wire_encode(item, self._codec, self._pool)
+        try:
+            n = _parts_nbytes(parts)
+            head = _OP_REPL_APPEND + struct.pack("<QQI", offset, floor, n)
+            _sendmsg_all(self._sock, [head, *parts])
+        finally:
+            if clease is not None:
+                clease.release()
+        return n
+
+    def read_ack(self, timeout_s: float) -> Optional[int]:
+        """One cumulative ack off the wire (None when no ack arrives
+        within ``timeout_s``; the timeout covers the status byte only —
+        once it lands, the offset follows at wire speed). 'E' raises
+        :class:`ReplicaRefused` (fenced / replica disk fault)."""
+        try:
+            self._sock.settimeout(timeout_s)
+            try:
+                st = _recv_exact(self._sock, 1)
+            except (BlockingIOError, socket.timeout):
+                return None
+        finally:
+            try:
+                self._sock.settimeout(self._timeout_s)
+            except OSError:
+                pass
+        if st != _ST_OK:
+            raise ReplicaRefused(
+                f"replica append refused by {self.host}:{self.port} "
+                f"({st!r}) — promoted out from under us, or its disk "
+                f"faulted"
+            )
+        (off,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+        return off
+
+    def hang_up(self) -> None:
+        """Close the link (a clean BYE when subscribed). Deliberately
+        NOT named ``close``: the event-loop-blocking checker resolves
+        call edges by name, and the loop's own ``.close()`` calls must
+        not drag this blocking client teardown into the audited set."""
+        if self._stream is not None:
+            try:
+                self._sock.sendall(_OP_BYE)
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ReplicationSender:
+    """Owner half of one chain hop: a daemon thread tailing one durable
+    queue's segment log and shipping it to the follower, windowed. The
+    log itself is the resend buffer — on reconnect the shipper resumes
+    at the follower's reported tail, so nothing is held in memory and
+    holes are impossible (the follower reconciles overlap by
+    truncate-to-offset).
+
+    The event loop reads exactly two things, both lock-held O(1):
+    :meth:`reached` (the replicated ack floor gating producer acks) and
+    :meth:`lag` (the obs gauge)."""
+
+    def __init__(
+        self,
+        manager: "ReplicationManager",
+        namespace: str,
+        queue_name: str,
+        queue,
+        follower: str,
+        window: int = DEFAULT_REPL_WINDOW,
+        codec: Optional[str] = None,
+        pool: Optional[BufferPool] = None,
+        degrade_after_s: float = DEFAULT_DEGRADE_AFTER_S,
+    ):
+        self._mgr = manager
+        self.namespace, self.queue_name = namespace, queue_name
+        self.queue = queue
+        self.log = queue.log
+        self.follower = follower
+        self._window = max(1, int(window))
+        self._codec = codec
+        self._pool = pool
+        self._degrade_after_s = degrade_after_s
+        self._lock = threading.Lock()
+        self._acked = -1  # replicated ack floor  # guarded-by: _lock
+        self._degraded = False  # guarded-by: _lock
+        self._fenced = False  # guarded-by: _lock
+        self._link_down_since: Optional[float] = None  # guarded-by: _lock
+        self._next_send = 0  # shipper-thread-local position
+        self._link: Optional[ReplicaLink] = None  # shipper-thread-local
+        # last moment the link made ACK progress (shipper-thread-local):
+        # a CONNECTED follower that stops acking (hung peer, blackholed
+        # link after the window filled) must hit the same degrade grace
+        # as a follower that refuses the dial
+        self._last_progress = time.monotonic()
+        self._stop = threading.Event()
+        self._wakeup = threading.Event()
+        queue.add_listener(self._poke)  # non-blocking: Event.set
+        REPL.track(self)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"repl-ship-{queue_name}",
+        )
+        self._thread.start()
+
+    # -- loop-facing surface (must stay non-blocking) ----------------------
+    def reached(self, offset: int) -> bool:
+        """Has the follower logged ``offset``? True also once DEGRADED
+        (link down past the grace window, or fenced by a promotion) —
+        availability over the replica guarantee, loudly breadcrumbed."""
+        with self._lock:
+            return self._degraded or self._acked >= offset
+
+    def acked_floor(self) -> int:
+        with self._lock:
+            return self._acked
+
+    def lag(self) -> int:
+        """Records appended on the owner but not yet follower-acked."""
+        with self._lock:
+            acked = self._acked
+        try:
+            return max(0, self.log.next_offset - 1 - acked)
+        except RuntimeError:  # log closed mid-teardown
+            return 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def _poke(self):
+        self._wakeup.set()
+
+    def stop(self):
+        self._stop.set()
+        self._wakeup.set()
+        self._thread.join(timeout=5.0)
+        REPL.untrack(self)
+        try:
+            self.queue.remove_listener(self._poke)
+        except Exception:  # noqa: BLE001 — queue may already be closed
+            pass
+
+    # -- the shipping thread ----------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self._pump()
+            except ReplicaRefused as e:
+                # fenced: the replica was promoted out from under us —
+                # WE are the zombie side of a failover. Stop for good
+                # (degraded opens the producer-ack gate).
+                self._drop_link()
+                with self._lock:
+                    self._fenced = True
+                    self._degraded = True
+                REPL.fenced()
+                FLIGHT.record(
+                    "replication_fenced", queue=self.queue_name,
+                    follower=self.follower, error=str(e),
+                )
+                self._mgr.progress()
+                return
+            except (ConnectionError, socket.timeout, OSError, RuntimeError):
+                self._drop_link()
+                REPL.reconnected()
+                # full-jitter pause before the redial (the same
+                # stampede-avoidance as the client reconnect backoff)
+                self._stop.wait(random.uniform(0.02, 0.3))
+        self._drop_link()
+
+    def _drop_link(self):
+        link, self._link = self._link, None
+        if link is not None:
+            link.hang_up()
+
+    def _connect(self) -> bool:
+        host, _, port = self.follower.rpartition(":")
+        try:
+            link = ReplicaLink(
+                host, int(port), self.namespace, self.queue_name,
+                codec=self._codec, pool=self._pool,
+            )
+        except (ConnectionError, socket.timeout, OSError):
+            self._note_link_down()
+            self._stop.wait(random.uniform(0.05, 0.5))
+            return False
+        try:
+            tail = link.subscribe()
+        except ReplicaRefused:
+            # fencing (or misconfig), not an outage — propagate to _run,
+            # which stops this sender for good; retrying forever would
+            # hammer a server that already answered
+            link.hang_up()
+            raise
+        except (ConnectionError, socket.timeout, OSError):
+            link.hang_up()
+            self._note_link_down()
+            self._stop.wait(random.uniform(0.05, 0.5))
+            return False
+        if tail > self.log.next_offset:
+            # the follower knows MORE than our log: we restarted with a
+            # rolled-back (or emptied) disk. Shipping from our tail
+            # would REWIND the replica over acknowledged records —
+            # destroying the only surviving copy. Refuse, loudly:
+            # fence ourselves and degrade (operators restart clients so
+            # the follower promotes, or restore this disk).
+            link.hang_up()
+            raise ReplicaRefused(
+                f"follower {self.follower} holds {tail} records of "
+                f"{self.queue_name} but the local log ends at "
+                f"{self.log.next_offset} — the owner restarted behind "
+                f"its replica; refusing to rewind the better copy"
+            )
+        self._link = link
+        self._next_send = min(tail, self.log.next_offset)
+        self._last_progress = time.monotonic()
+        with self._lock:
+            self._link_down_since = None
+            if tail - 1 > self._acked:
+                # the follower already holds more than we knew (we
+                # restarted, it did not)
+                self._acked = tail - 1
+            was_degraded = self._degraded
+            self._degraded = False
+        REPL.link_opened()
+        if was_degraded:
+            REPL.restored()
+            FLIGHT.record(
+                "replication_restored", queue=self.queue_name,
+                follower=self.follower, resume_at=self._next_send,
+            )
+        FLIGHT.record(
+            "replica_link_open", queue=self.queue_name,
+            follower=self.follower, tail=tail,
+        )
+        self._mgr.progress()
+        return True
+
+    def _note_link_down(self):
+        with self._lock:
+            if self._link_down_since is None:
+                self._link_down_since = time.monotonic()
+            down_s = time.monotonic() - self._link_down_since
+        if down_s > self._degrade_after_s:
+            self._flip_degraded()
+
+    def _flip_degraded(self):
+        with self._lock:
+            if self._degraded:
+                return
+            self._degraded = True
+        REPL.degraded()
+        FLIGHT.record(
+            "replication_degraded", queue=self.queue_name,
+            follower=self.follower,
+        )
+        self._mgr.progress()  # parked producer acks flow again
+
+    def _pump(self):
+        if self._link is None and not self._connect():
+            return
+        link = self._link
+        tail = self.log.next_offset
+        floor = getattr(self.queue, "committed_floor", -1)
+        wire_floor = floor if floor >= 0 else _REPL_NO_FLOOR
+        shipped = nbytes = 0
+        while (
+            self._next_send < tail
+            and self._next_send - self.acked_floor() <= self._window
+            and not self._stop.is_set()
+        ):
+            try:
+                item = self.log.read(self._next_send)
+            except KeyError:
+                # retention lapped the link (consumed history only —
+                # the owner never recycles unconsumed records): skip
+                # forward, loudly
+                earliest = self.log.first_retained_offset()
+                if earliest <= self._next_send:
+                    earliest = self._next_send + 1
+                FLIGHT.record(
+                    "replication_gap", queue=self.queue_name,
+                    skipped_from=self._next_send, resumed_at=earliest,
+                )
+                with self._lock:
+                    # unshippable records can never gate producer acks
+                    if earliest - 1 > self._acked:
+                        self._acked = earliest - 1
+                self._next_send = earliest
+                continue
+            nbytes += link.ship(self._next_send, wire_floor, item)
+            self._next_send += 1
+            shipped += 1
+        if shipped:
+            REPL.shipped(shipped, nbytes)
+        # drain acks: non-blocking while more waits to ship, a bounded
+        # slice when the window is full or we are caught up
+        caught_up = self._next_send >= self.log.next_offset
+        window_full = self._next_send - self.acked_floor() > self._window
+        inflight = self._next_send - 1 > self.acked_floor()
+        advanced = False
+        if inflight:
+            off = link.read_ack(0.2 if (caught_up or window_full) else 0.0)
+            while off is not None:
+                with self._lock:
+                    if off > self._acked:
+                        self._acked = off
+                        advanced = True
+                off = link.read_ack(0.0)
+        now = time.monotonic()
+        if advanced:
+            self._last_progress = now
+            restored = False
+            with self._lock:
+                if self._degraded:
+                    self._degraded = False
+                    restored = True
+            if restored:
+                REPL.restored()
+                FLIGHT.record(
+                    "replication_restored", queue=self.queue_name,
+                    follower=self.follower, resume_at=self._next_send,
+                )
+            self._mgr.progress()  # wake the loop: parked acks may flow
+        elif inflight and now - self._last_progress > self._degrade_after_s:
+            # connected but not acking: the degrade grace applies here
+            # exactly as to a refused dial — degrade loudly rather than
+            # wedge producers behind a hung follower
+            self._flip_degraded()
+        if caught_up and not inflight:
+            # idle: wait for the queue listener's poke (or the tick)
+            self._wakeup.clear()
+            if self.log.next_offset <= self._next_send:
+                self._wakeup.wait(0.2)
+
+
+class _ReplicaEntry:
+    """One hosted replica log on a follower."""
+
+    __slots__ = ("log", "promoted", "floor_seen", "floor_committed")
+
+    def __init__(self, log: SegmentLog):
+        self.log = log
+        self.promoted = False
+        self.floor_seen = -1  # latest piggybacked owner floor
+        self.floor_committed = -1  # last floor persisted to the log
+
+
+class ReplicaSet:
+    """Follower half: passive replica segment logs by (namespace,
+    queue name), living in the SAME ``durable_dir`` layout as live
+    queues — promotion is therefore "close the replica handle, let the
+    durable factory's recovery scan mount the very same directory"."""
+
+    def __init__(
+        self,
+        durable_dir: str,
+        segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+        retain_segments: int = DEFAULT_RETAIN_SEGMENTS,
+        fsync: str = FSYNC_BATCH,
+        fsync_batch_n: int = DEFAULT_FSYNC_BATCH_N,
+    ):
+        self.durable_dir = durable_dir
+        self._segment_bytes = segment_bytes
+        self._retain_segments = retain_segments
+        self._fsync = fsync
+        self._fsync_batch_n = fsync_batch_n
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], _ReplicaEntry] = {}  # guarded-by: _lock
+
+    def subscribe_log(self, namespace: str, queue_name: str):
+        """The 'H' half: get-or-create the replica log for the named
+        queue (None once promoted — the fencing refusal)."""
+        key = (namespace, queue_name)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                return None if entry.promoted else entry
+            log = SegmentLog(
+                os.path.join(
+                    self.durable_dir, f"{namespace}__{queue_name}"
+                ),
+                segment_bytes=self._segment_bytes,
+                retain_segments=self._retain_segments,
+                fsync=self._fsync,
+                fsync_batch_n=self._fsync_batch_n,
+                name=f"replica:{namespace}/{queue_name}",
+            )
+            entry = _ReplicaEntry(log)
+            self._entries[key] = entry
+            return entry
+
+    def ingest(self, entry: _ReplicaEntry, offset: int, floor: int, item) -> bool:
+        """The 'V' half: reconcile + append one record at the owner's
+        offset. False once promoted (fenced). Divergence reconciles by
+        truncate-to-offset (the owner's live view wins), a forward gap
+        by reset (the owner's retention passed us — consumed history
+        only)."""
+        with self._lock:
+            if entry.promoted:
+                return False
+        log = entry.log
+        tail = log.next_offset
+        if offset < tail:
+            log.truncate_to(offset)
+        elif offset > tail:
+            log.reset_to(offset)
+        log.append_at(offset, item)
+        if floor != _REPL_NO_FLOOR and floor > entry.floor_seen:
+            entry.floor_seen = floor
+            if floor >= entry.floor_committed + FLOOR_COMMIT_STRIDE:
+                log.commit(floor, "")
+                entry.floor_committed = floor
+        REPL.replica_appended()
+        return True
+
+    def promote(self, namespace: str, queue_name: str) -> Optional[Tuple[int, int]]:
+        """The 'Y' half: fence the replica against further appends,
+        persist the exact latest owner floor, flush, and RELEASE the
+        mapping so the durable factory can mount the directory as the
+        live queue. Returns the retained (start, end) range, or None
+        when no (unpromoted) replica exists here."""
+        with self._lock:
+            entry = self._entries.get((namespace, queue_name))
+            if entry is None or entry.promoted:
+                return None
+            entry.promoted = True
+        log = entry.log
+        if entry.floor_seen > entry.floor_committed:
+            log.commit(entry.floor_seen, "")
+            entry.floor_committed = entry.floor_seen
+        start = log.first_retained_offset()
+        end = log.next_offset
+        try:
+            log.sync()
+        except OSError:
+            pass  # breadcrumbed by the log; promote anyway
+        log.close()
+        REPL.promoted()
+        FLIGHT.record(
+            "replica_promote", queue=f"{namespace}/{queue_name}",
+            start=start, end=end,
+        )
+        return (start, end)
+
+    def close_all(self):
+        with self._lock:
+            entries, self._entries = dict(self._entries), {}
+        for entry in entries.values():
+            if not entry.promoted:
+                entry.log.close()
+
+
+class ReplicationManager:
+    """The server-side replication brain: owns the follower-facing
+    :class:`ReplicaSet`, the owner-facing :class:`ReplicationSender`
+    fleet, and the coordinator snapshot-sync thread. Constructed by
+    ``queue_server`` (``--replicate_peers``/``--advertise``) or tests
+    and handed to ``TcpQueueServer(replication=...)``."""
+
+    def __init__(
+        self,
+        durable_dir: str,
+        peers,
+        advertise: str,
+        codec: Optional[str] = None,
+        window: int = DEFAULT_REPL_WINDOW,
+        pool: Optional[BufferPool] = None,
+        segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+        retain_segments: int = DEFAULT_RETAIN_SEGMENTS,
+        fsync: str = FSYNC_BATCH,
+        fsync_batch_n: int = DEFAULT_FSYNC_BATCH_N,
+        lease_ttl_s: float = 10.0,
+        degrade_after_s: float = DEFAULT_DEGRADE_AFTER_S,
+    ):
+        self.peers = list(peers)
+        self.advertise = advertise
+        if codec and codec != "auto":
+            # fail fast at construction: an unknown codec raising inside
+            # the shipper thread would kill it silently and leave the
+            # replicated ack floor gating producers forever
+            get_codec(codec)
+        self._codec = codec
+        self._window = window
+        self._pool = pool
+        self._lease_ttl_s = lease_ttl_s
+        self._degrade_after_s = degrade_after_s
+        self.replicas = ReplicaSet(
+            durable_dir,
+            segment_bytes=segment_bytes,
+            retain_segments=retain_segments,
+            fsync=fsync,
+            fsync_batch_n=fsync_batch_n,
+        )
+        self._lock = threading.Lock()
+        self._senders: Dict[int, ReplicationSender] = {}  # id(queue) ->  # guarded-by: _lock
+        self._server = None  # set once by attach()
+        self._groups_dirty = threading.Event()
+        self._stop = threading.Event()
+        self._coord_thread: Optional[threading.Thread] = None
+        REPL.ensure_registered()
+
+    # -- server wiring -----------------------------------------------------
+    def attach(self, server) -> None:
+        self._server = server
+        if len(self.peers) > 1 and self.advertise:
+            # coordinator snapshot replication: every group mutation
+            # arms a push to the next live peer under the leader lease
+            server.groups.on_mutate = self._groups_dirty.set
+            self._coord_thread = threading.Thread(
+                target=self._coord_run, daemon=True, name="repl-coord-sync"
+            )
+            self._coord_thread.start()
+
+    def progress(self) -> None:
+        """Wake the server's event loop: the replicated ack floor moved
+        (or degraded) and parked producer replies may flow."""
+        srv = self._server
+        loop = getattr(srv, "_loop", None) if srv is not None else None
+        if loop is not None:
+            loop.wake()
+
+    def queue_mounted(self, namespace: str, queue_name: str, queue) -> None:
+        """open_named hook on the OWNER side: if this server sits in the
+        partition's chain with a next link, start shipping the queue's
+        log there."""
+        log = getattr(queue, "log", None)
+        if log is None or not self.advertise or len(self.peers) < 2:
+            return  # memory-only queue, or nothing to chain to
+        base, part = parse_partition(queue_name)
+        follower = next_in_chain(self.peers, self.advertise, base, part)
+        if follower is None or follower == self.advertise:
+            return
+        with self._lock:
+            if self._stop.is_set() or id(queue) in self._senders:
+                return
+            self._senders[id(queue)] = ReplicationSender(
+                self, namespace, queue_name, queue, follower,
+                window=self._window, codec=self._codec, pool=self._pool,
+                degrade_after_s=self._degrade_after_s,
+            )
+        FLIGHT.record(
+            "replica_chain", queue=f"{namespace}/{queue_name}",
+            follower=follower,
+        )
+
+    def sender_for(self, queue) -> Optional[ReplicationSender]:
+        with self._lock:
+            return self._senders.get(id(queue))
+
+    # -- event-loop opcode surface ----------------------------------------
+    def replica_open(self, namespace: str, queue_name: str):
+        srv = self._server
+        if srv is not None and srv.has_named_queue(namespace, queue_name):
+            return None  # mounted live here: never also a passive replica
+        return self.replicas.subscribe_log(namespace, queue_name)
+
+    def replica_append(self, entry, offset: int, floor: int, item) -> bool:
+        return self.replicas.ingest(entry, offset, floor, item)
+
+    def promote(self, namespace: str, queue_name: str):
+        return self.replicas.promote(namespace, queue_name)
+
+    def ensure_promoted(self, namespace: str, queue_name: str) -> None:
+        """Implicit promotion on OPEN — defense in depth behind the
+        explicit 'Y' (a plain client failing over without the cluster
+        layer still mounts the replicated backlog)."""
+        self.replicas.promote(namespace, queue_name)
+
+    # -- coordinator snapshot sync ----------------------------------------
+    def _coord_run(self):
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        while not self._stop.is_set():
+            self._groups_dirty.wait(self._lease_ttl_s / 2)
+            if self._stop.is_set():
+                return
+            if not self._groups_dirty.is_set():
+                continue
+            self._groups_dirty.clear()
+            srv = self._server
+            if srv is None:
+                continue
+            snap = srv.groups.snapshot_groups()
+            if not snap:
+                continue
+            if not self._push_snapshot(TcpQueueClient, snap):
+                # no reachable peer took it (or the lease is held
+                # elsewhere): retry after a beat, never hot-loop
+                self._groups_dirty.set()
+                self._stop.wait(0.5)
+
+    def _push_snapshot(self, client_cls, snap: dict) -> bool:
+        for peer in self._chain_peers():
+            host, _, port = peer.rpartition(":")
+            try:
+                c = client_cls(
+                    host, int(port), timeout_s=5.0,
+                    reconnect_tries=1, reconnect_base_s=0.1,
+                )
+            except TransportClosed:
+                continue
+            try:
+                lease = c.cluster_rpc({
+                    "op": "lease", "holder": self.advertise,
+                    "ttl": self._lease_ttl_s,
+                })
+                if not lease.get("ok"):
+                    # another holder's lease is live — we are probably
+                    # the deposed side of a coordinator failover: back
+                    # off rather than fight
+                    REPL.lease_was_denied()
+                    FLIGHT.record(
+                        "lease_denied", peer=peer,
+                        holder=lease.get("holder"),
+                    )
+                    return False
+                resp = c.cluster_rpc({
+                    "op": "sync", "holder": self.advertise, "groups": snap,
+                })
+                if resp.get("ok"):
+                    REPL.coord_synced()
+                    return True
+            except (TransportClosed, RuntimeError):
+                continue
+            finally:
+                try:
+                    c.disconnect()
+                except Exception:  # noqa: BLE001 — already closing
+                    pass
+        return False
+
+    def _chain_peers(self):
+        """Peers after self in the configured order, wrapping — the
+        coordinator replication chain."""
+        if self.advertise in self.peers:
+            i = self.peers.index(self.advertise)
+            return self.peers[i + 1:] + self.peers[:i]
+        return [p for p in self.peers if p != self.advertise]
+
+    # -- lifecycle ---------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._groups_dirty.set()
+        with self._lock:
+            senders, self._senders = list(self._senders.values()), {}
+        for s in senders:
+            s.stop()
+        t = self._coord_thread
+        if t is not None:
+            t.join(timeout=3.0)
+        self.replicas.close_all()

@@ -28,6 +28,8 @@ class ClusterTelemetry:
         self.tail_resent = 0  # unacked windowed-put tail frames resent  # guarded-by: _lock
         self.partitions_drained = 0  # guarded-by: _lock
         self.eos_aggregated = 0  # synthesized end-of-stream markers emitted  # guarded-by: _lock
+        self.promotes_requested = 0  # replica promotions sent on failover  # guarded-by: _lock
+        self.promotes_served = 0  # ...that found a replica to promote  # guarded-by: _lock
         self.depth_by_server: dict = {}  # last probed depth per server  # guarded-by: _lock
 
     def ensure_registered(self):
@@ -74,6 +76,12 @@ class ClusterTelemetry:
         with self._lock:
             self.eos_aggregated += 1
 
+    def promoted(self, served: bool):
+        with self._lock:
+            self.promotes_requested += 1
+            if served:
+                self.promotes_served += 1
+
     def observe_depths(self, depths: dict):
         with self._lock:
             self.depth_by_server = dict(depths)
@@ -93,6 +101,8 @@ class ClusterTelemetry:
                 "tail_resent_total": self.tail_resent,
                 "partitions_drained_total": self.partitions_drained,
                 "eos_aggregated_total": self.eos_aggregated,
+                "promotes_requested_total": self.promotes_requested,
+                "promotes_served_total": self.promotes_served,
                 "depth_by_server": dict(self.depth_by_server),
             }
 

@@ -147,15 +147,17 @@ def default_target_files() -> List[pathlib.Path]:
 
 # files the CROSS-FILE checkers anchor at; an incremental run always
 # carries them so a subset scan cannot fabricate findings:
-# - transport/tcp.py + transport/evloop.py: wire-protocol and
-#   protocol-dialogue need both sides or every sent opcode looks
-#   undispatched;
+# - transport/tcp.py + transport/evloop.py + cluster/replication.py:
+#   wire-protocol and protocol-dialogue need every side of the protocol
+#   or a sent opcode looks undispatched (the replication link's
+#   'H'/'V' senders live in cluster/replication.py since ISSUE 11);
 # - infeed/batcher.py + infeed/fanin.py: blocking-hot-path's drain-loop
 #   roots live there, and its root-resolution rot guard (rightly)
 #   refuses to run silently uncovered on a >10-file scan
 PROTOCOL_COMPANIONS = (
     "psana_ray_tpu/transport/tcp.py",
     "psana_ray_tpu/transport/evloop.py",
+    "psana_ray_tpu/cluster/replication.py",
 )
 INCREMENTAL_COMPANIONS = PROTOCOL_COMPANIONS + (
     "psana_ray_tpu/infeed/batcher.py",

@@ -92,6 +92,41 @@ def main(argv=None):
         "delivery to log reads (0 = the queue's --queue_size)",
     )
     p.add_argument(
+        "--replicate_peers",
+        default=None,
+        metavar="HOST:PORT,...",
+        help=(
+            "chain-replicate durable partition logs across this static "
+            "server list (ISSUE 11): each durable queue this server "
+            "owns ships its segment log to the next server in the "
+            "partition's rendezvous ranking, producer acks wait for "
+            "the follower (replicated ack floor), and the consumer-"
+            "group coordinator snapshot replicates under a leader "
+            "lease. Every server of the cluster should be started "
+            "with the SAME list. Requires --durable_dir and "
+            "--advertise"
+        ),
+    )
+    p.add_argument(
+        "--advertise",
+        default=None,
+        metavar="HOST:PORT",
+        help=(
+            "this server's own address AS IT APPEARS in "
+            "--replicate_peers (placement is computed from the peer "
+            "list, so the spelling must match exactly)"
+        ),
+    )
+    p.add_argument(
+        "--replica_codec",
+        default=None,
+        help=(
+            "wire codec for the replication links ('auto', a codec "
+            "name, or unset for raw) — the segment log ships "
+            "compressed exactly like any other negotiated link"
+        ),
+    )
+    p.add_argument(
         "--port_file", default=None,
         help="write the bound port to this file once listening (harness "
         "support: lets a supervisor/test start with --port 0 and learn "
@@ -149,10 +184,23 @@ def main(argv=None):
 
     queue_factory = None
     group_store_path = None
+    replication = None
     if a.durable_dir and a.shm:
         p.error("--durable_dir and --shm are mutually exclusive (the "
                 "segment log backs in-process queues; shm rings have "
                 "their own lifetime)")
+    if a.replicate_peers and not (a.durable_dir and a.advertise):
+        p.error("--replicate_peers requires --durable_dir (the segment "
+                "log is what replicates) and --advertise (this server's "
+                "own address in the peer list)")
+    if a.replicate_peers:
+        _peers = [s.strip() for s in a.replicate_peers.split(",") if s.strip()]
+        if a.advertise not in _peers:
+            # a spelling mismatch would silently disable all shipping
+            # (placement can't find this server in the chain)
+            p.error(f"--advertise {a.advertise!r} does not appear in "
+                    f"--replicate_peers {_peers} — the spellings must "
+                    f"match exactly or no queue will ever replicate")
     if a.durable_dir:
         import os
 
@@ -194,6 +242,24 @@ def main(argv=None):
             "retain=%d, fsync=%s)",
             a.durable_dir, a.segment_bytes, a.retain_segments, a.fsync,
         )
+        if a.replicate_peers:
+            from psana_ray_tpu.cluster.replication import ReplicationManager
+
+            peers = [s.strip() for s in a.replicate_peers.split(",") if s.strip()]
+            replication = ReplicationManager(
+                a.durable_dir, peers, a.advertise,
+                codec=a.replica_codec,
+                segment_bytes=a.segment_bytes,
+                retain_segments=a.retain_segments,
+                fsync=a.fsync,
+                fsync_batch_n=a.fsync_batch_n,
+            )
+            logger.info(
+                "replication: chain over %s (advertise=%s, codec=%s) — "
+                "owned durable queues ship to their rendezvous "
+                "runner-up; producer acks ride the replicated floor",
+                peers, a.advertise, a.replica_codec or "raw",
+            )
     elif a.shm:
         from psana_ray_tpu.transport.shm_ring import ShmRingBuffer
 
@@ -221,7 +287,7 @@ def main(argv=None):
     server = TcpQueueServer(
         backing, host=a.host, port=a.port, maxsize=a.queue_size,
         queue_factory=queue_factory, max_conns=a.max_conns,
-        group_store_path=group_store_path,
+        group_store_path=group_store_path, replication=replication,
     ).serve_background()
     if a.port_file:
         with open(a.port_file + ".tmp", "w") as f:

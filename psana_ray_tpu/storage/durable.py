@@ -159,6 +159,38 @@ class DurableRingBuffer(RingBuffer):
             self._outstanding[id(item)] = entry
         return item
 
+    # -- replicated ack floor support (ISSUE 11) ---------------------------
+    def put(self, item: Any) -> bool:
+        """One admission implementation: :meth:`put_offset` is the
+        primitive (the event-loop's replicated-ack-floor gate needs the
+        offset); ``put`` is its offset-discarding face."""
+        return self.put_offset(item)[0]
+
+    def put_offset(self, item: Any):
+        """``put`` that also reports the appended record's log offset —
+        the event-loop server's replicated-ack-floor gate needs it to
+        hold the producer's ack until the follower has logged exactly
+        this record. Returns ``(ok, offset)``; ``(False, None)`` when
+        full."""
+        with self._lock:
+            self._check_open()
+            self._check_accepting()
+            if len(self._q) >= self.maxsize:
+                self._n_put_rejected += 1
+                return False, None
+            entry = self._box(item)
+            self._q.append(entry)
+            self._note_put()
+            self._not_empty.notify()
+            return True, entry.offset
+
+    @property
+    def committed_floor(self) -> int:
+        """The live committed floor — piggybacked on replica appends so
+        a promoted follower re-exposes only ``(floor, tail]``."""
+        with self._lock:
+            return self._floor
+
     # -- committed offsets -------------------------------------------------
     def ack_delivered(self, items) -> int:
         """The delivery of ``items`` is confirmed (the event-loop server
@@ -211,8 +243,8 @@ class DurableRingBuffer(RingBuffer):
         super().close()
         try:
             self.log.sync()
-        except RuntimeError:
-            pass  # log already closed
+        except (RuntimeError, OSError):
+            pass  # log already closed / disk fault already breadcrumbed
 
     def stats(self) -> dict:
         out = super().stats()

@@ -78,6 +78,26 @@ _OFFSETS_COMPACT_BYTES = 64 * 1024
 # truly unlinked — the free list that makes a roll an O(1) rename
 _FREE_SEGMENTS_MAX = 2
 
+# Patchable disk-fault hook (tests/faultproxy.DiskFaultInjector): called
+# with the op name ("append"/"sync") before the segment write or flush;
+# raising OSError simulates a failing/full disk. The log degrades LOUDLY
+# on it — DURABLE counter + flight breadcrumb + the OSError surfacing to
+# the caller (the event-loop server answers the producer 'E') — instead
+# of wedging or killing the serving loop.
+_DISK_FAULT_HOOK = None
+
+
+def set_disk_fault_hook(hook) -> None:
+    """Install (or clear, with None) the process-wide disk-fault hook."""
+    global _DISK_FAULT_HOOK
+    _DISK_FAULT_HOOK = hook
+
+
+def _disk_fault_check(op: str) -> None:
+    hook = _DISK_FAULT_HOOK
+    if hook is not None:
+        hook(op)
+
 
 class SegmentLog:
     """See module docstring. Thread-safe behind one lock."""
@@ -232,15 +252,46 @@ class SegmentLog:
     # -- append ------------------------------------------------------------
     def append(self, item) -> int:
         """Append one record; returns its assigned offset."""
+        need = self._check_fits(item)
+        with self._lock:
+            self._check_open()
+            offset = self._next_offset
+            self._append_locked(offset, item, need)
+            self._next_offset = offset + 1
+            return offset
+
+    def append_at(self, offset: int, item) -> int:
+        """Append one record under an EXPLICIT offset — the replica path
+        (ISSUE 11): a follower mirrors the owner's offset space so a
+        promoted replica serves the same addresses. ``offset`` must equal
+        the tail; the caller reconciles divergence first
+        (:meth:`truncate_to` / :meth:`reset_to`)."""
+        need = self._check_fits(item)
+        with self._lock:
+            self._check_open()
+            if offset != self._next_offset:
+                raise ValueError(
+                    f"append_at out of order: offset {offset} vs tail "
+                    f"{self._next_offset} (reconcile with truncate_to/"
+                    f"reset_to first)"
+                )
+            self._append_locked(offset, item, need)
+            self._next_offset = offset + 1
+            return offset
+
+    def _check_fits(self, item) -> int:
         need = record_nbytes(item)
         if need > self.segment_bytes:
             raise ValueError(
                 f"record of {need} framed bytes exceeds segment_bytes="
                 f"{self.segment_bytes}"
             )
-        with self._lock:
-            self._check_open()
-            offset = self._next_offset
+        return need
+
+    def _append_locked(self, offset: int, item, need: int) -> None:
+        # guarded-by-caller: _lock
+        try:
+            _disk_fault_check("append")
             seg = self._segments[-1]
             if seg.append(offset, item) is None:
                 seg = self._roll()
@@ -248,7 +299,6 @@ class SegmentLog:
                     raise RuntimeError(
                         f"record did not fit a fresh segment ({need} bytes)"
                     )
-            self._next_offset = offset + 1
             DURABLE.appended(need)
             if self.fsync == FSYNC_ALWAYS:
                 seg.sync()
@@ -259,7 +309,68 @@ class SegmentLog:
                     self._appends_since_sync = 0
                     seg.sync()
                     DURABLE.fsynced()
-            return offset
+        except OSError as e:
+            # a failing/full disk degrades LOUDLY: counter + breadcrumb
+            # + the exception surfacing as THIS append's failure (the
+            # event-loop server answers the producer 'E' and lives on)
+            DURABLE.disk_faulted()
+            FLIGHT.record(
+                "disk_fault", log=self.name, op="append", error=repr(e)
+            )
+            raise
+
+    # -- replica reconciliation (ISSUE 11) ---------------------------------
+    def truncate_to(self, offset: int) -> None:
+        """Discard every record with offset >= ``offset`` so the next
+        append lands there. The follower's torn-tail sibling: after an
+        owner reconnect, the owner's view of the unacknowledged suffix
+        WINS — the replica rewinds and the overwriting appends (and any
+        later recovery scan) see a clean end. Committed floors are
+        untouched (monotonic, and always at or below the acked range)."""
+        with self._lock:
+            self._check_open()
+            if offset >= self._next_offset:
+                return
+            if offset <= self.first_retained_offset():
+                self._reset_locked(offset)
+            else:
+                while self._segments:
+                    seg = self._segments[-1]
+                    first = seg.first_offset
+                    if first is not None and first < offset:
+                        pos = seg.find(offset)
+                        if pos is not None:
+                            seg.truncate_from(pos)
+                        break
+                    # the whole tail segment goes (including empty ones)
+                    self._segments.pop()
+                    seg.close()
+                    os.unlink(seg.path)
+                if not self._segments:
+                    self._segments.append(self._new_segment(offset))
+                self._next_offset = offset
+            DURABLE.truncated()
+        FLIGHT.record("replica_truncate", log=self.name, to_offset=offset)
+
+    def reset_to(self, offset: int) -> None:
+        """Forget everything and restart the offset space at ``offset``
+        (the owner's earliest shippable record lies beyond our tail — a
+        contiguous local copy is impossible, so the replica restarts
+        there; loudly breadcrumbed, consumed-history-only by the owner's
+        retention contract)."""
+        with self._lock:
+            self._check_open()
+            self._reset_locked(offset)
+        FLIGHT.record("replica_reset", log=self.name, to_offset=offset)
+
+    def _reset_locked(self, offset: int) -> None:
+        # guarded-by-caller: _lock
+        for seg in self._segments:
+            seg.close()
+            os.unlink(seg.path)
+        self._segments = []
+        self._segments.append(self._new_segment(offset))
+        self._next_offset = offset
 
     # -- read --------------------------------------------------------------
     def read(self, offset: int):
@@ -358,7 +469,15 @@ class SegmentLog:
         with self._lock:
             if self._closed:
                 return
-            self._segments[-1].sync()
+            try:
+                _disk_fault_check("sync")
+                self._segments[-1].sync()
+            except OSError as e:
+                DURABLE.disk_faulted()
+                FLIGHT.record(
+                    "disk_fault", log=self.name, op="sync", error=repr(e)
+                )
+                raise
             DURABLE.fsynced()
             self._appends_since_sync = 0
 

@@ -205,6 +205,22 @@ class Segment:
             return self.index[i][1]
         return None
 
+    def truncate_from(self, pos: int) -> None:
+        """Discard every record at file position >= ``pos`` (the replica
+        reconciliation path, ISSUE 11: a promoted-then-deposed or
+        diverged suffix is scrubbed so the overwriting appends — and any
+        later recovery scan — see a clean end, exactly like a torn-tail
+        repair)."""
+        if pos >= self.write_pos:
+            return
+        cursor = pos
+        while cursor < self.write_pos:
+            n = min(len(_ZEROS), self.write_pos - cursor)
+            self._mv[cursor : cursor + n] = _ZEROS[:n]
+            cursor += n
+        self.index = [(off, p) for (off, p) in self.index if p < pos]
+        self.write_pos = pos
+
     # -- recovery ----------------------------------------------------------
     def scan(self, expect_from: int) -> Tuple[int, bool]:
         """Rebuild the index from disk after a restart: walk records from

@@ -30,6 +30,8 @@ class DurabilityTelemetry:
         self.recovered_records_last = 0  # guarded-by: _lock
         self.torn_tail_repairs = 0  # guarded-by: _lock
         self.replay_opens = 0  # guarded-by: _lock
+        self.disk_faults_total = 0  # guarded-by: _lock
+        self.replica_truncates = 0  # guarded-by: _lock
 
     def ensure_registered(self):
         with self._lock:
@@ -85,6 +87,14 @@ class DurabilityTelemetry:
         with self._lock:
             self.replay_opens += 1
 
+    def disk_faulted(self):
+        with self._lock:
+            self.disk_faults_total += 1
+
+    def truncated(self):
+        with self._lock:
+            self.replica_truncates += 1
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -102,6 +112,8 @@ class DurabilityTelemetry:
                 "recovered_records_last": self.recovered_records_last,
                 "torn_tail_repairs": self.torn_tail_repairs,
                 "replay_opens": self.replay_opens,
+                "disk_faults_total": self.disk_faults_total,
+                "replica_truncates": self.replica_truncates,
             }
 
     # obs registry source protocol

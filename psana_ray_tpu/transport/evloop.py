@@ -17,7 +17,7 @@ Design:
   non-blocking scatter-gather writes with EPOLLOUT-driven partial-send
   resumption. Thread count is independent of connection count; memory
   is O(connections x small struct).
-- Each connection is a :class:`_EvConn` state machine over all 19
+- Each connection is a :class:`_EvConn` state machine over all 22
   opcodes of the wire protocol (the opcode constants and
   part-gathering helpers are imported from ``transport.tcp``, so the
   wire format cannot fork). Reads land incrementally: control
@@ -93,15 +93,19 @@ from psana_ray_tpu.transport.tcp import (
     _OP_OPEN,
     _OP_PUT,
     _OP_PUT_BATCH,
+    _OP_PROMOTE,
     _OP_PUT_SEQ,
     _OP_PUT_WAIT,
     _OP_REPLAY,
+    _OP_REPL_APPEND,
+    _OP_REPL_OPEN,
     _OP_SIZE,
     _OP_STATS,
     _OP_STREAM,
     _OP_STREAM_ACK,
     _SENDMSG_IOV,
     _SERVER_WAIT_CAP_S,
+    _REPL_NO_FLOOR,
     _ST_CLOSED,
     _ST_ERR,
     _ST_NO,
@@ -233,12 +237,20 @@ class _StreamState:
 class _QueueState:
     """Loop-side view of one backing queue: who is waiting on it."""
 
-    __slots__ = ("queue", "get_waiters", "put_waiters", "listened", "unlisten")
+    __slots__ = (
+        "queue", "get_waiters", "put_waiters", "ra_waiters", "repl",
+        "listened", "unlisten",
+    )
 
     def __init__(self, queue):
         self.queue = queue
         self.get_waiters: deque = deque()  # 'D' waiters + stream conns
         self.put_waiters: deque = deque()  # 'U'/'W' waiters, FIFO
+        # replicated-ack-floor waiters (ISSUE 11): puts already logged
+        # and enqueued whose producer ack is HELD until the follower has
+        # logged them (pending kind "RA"); FIFO == offset order
+        self.ra_waiters: deque = deque()
+        self.repl = None  # the queue's ReplicationSender, cached
         self.listened = False
         self.unlisten = None  # callable removing the change listener
 
@@ -254,12 +266,13 @@ class _EvConn:
 
     __slots__ = (
         "loop", "sock", "srv", "queue", "in_flight", "out", "out_bytes",
-        "closing", "closed", "stream", "replay", "pending", "op_gen",
-        "codec", "_out_enq_total", "_out_releases",
+        "closing", "closed", "stream", "replay", "replica", "pending",
+        "op_gen", "codec", "_out_enq_total", "_out_releases",
         "_hdr", "_hdr_mv", "_target", "_need", "_got", "_cb", "_lease",
         "_want_read", "_want_write", "_mask", "_sendmsg",
-        "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq", "_r_from",
-        "_open_ns", "_open_nm", "_open_buf",
+        "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq",
+        "_r_from", "_v_off", "_v_floor", "_open_ns", "_open_nm",
+        "_open_buf",
     )
 
     def __init__(self, loop: "EventLoop", sock: socket.socket, srv):
@@ -289,6 +302,10 @@ class _EvConn:
         # durable replay cursor ('R'): when set, this connection's reads
         # serve the log non-destructively instead of popping the queue
         self.replay = None
+        # replica mode ('H', ISSUE 11): when set (a _ReplicaEntry), this
+        # connection is an owner's replication link — it carries only
+        # 'V' appends downstream and their cumulative acks back
+        self.replica = None
         self.pending: Optional[dict] = None  # deferred 'D'/'U'/'W' state
         self.op_gen = 0  # staleness guard for timer-heap entries
         self._hdr = bytearray(64)  # reused control-field scratch
@@ -307,6 +324,8 @@ class _EvConn:
         self._pw_wait_s = 0.0
         self._w_seq = 0
         self._r_from = 0
+        self._v_off = 0
+        self._v_floor = 0
         self._open_ns = ""
         self._open_nm = ""
         self._open_buf = b""
@@ -482,6 +501,17 @@ class _EvConn:
         # next request after reading the last response) — implicit ACK
         self._ack_in_flight()
         self.in_flight = []
+        if self.replica is not None:
+            # a replica-link connection carries only appends and BYE
+            if op == _OP_REPL_APPEND[0]:
+                self._expect(20, self._va_hdr)
+                return
+            if op == _OP_BYE[0]:
+                self._begin_close()
+                return
+            raise ConnectionError(
+                f"bad opcode {op:#04x} on replica connection"
+            )
         if self.stream is not None:
             # a streamed connection carries only acks and BYE upstream
             if op == _OP_STREAM_ACK[0]:
@@ -572,19 +602,44 @@ class _EvConn:
     def _try_put(self, item):
         """``queue.put`` with refusals surfaced as ANSWERS: a queue
         exception beyond TransportClosed (e.g. a durable queue rejecting
-        a record larger than segment_bytes) must error THIS request —
-        killing the connection instead would make a windowed producer
-        resend the identical poison record on every reconnect until its
-        retries exhaust with a misleading connection-death error.
-        Returns True/False (enqueued / full), or None when a refusal
-        was already answered."""
+        a record larger than segment_bytes, or a disk fault) must error
+        THIS request — killing the connection instead would make a
+        windowed producer resend the identical poison record on every
+        reconnect until its retries exhaust with a misleading
+        connection-death error. Returns ``(ok, offset)`` — ``offset`` is
+        the durable log offset (None for memory queues), the replicated
+        ack floor's gate key — or ``(None, None)`` when a refusal was
+        already answered."""
         try:
-            return self.queue.put(item)
+            put_offset = getattr(self.queue, "put_offset", None)
+            if put_offset is not None:
+                return put_offset(item)
+            return self.queue.put(item), None
         except TransportClosed:
             self._send_control(_ST_CLOSED)
         except Exception:  # noqa: BLE001 — answer, don't kill the conn
             self._send_control(_ST_ERR)
-        return None
+        return None, None
+
+    def _answer_put(self, parts, offset, parked: bool = False) -> None:
+        """Send a successful put's reply — or HOLD it until the queue's
+        replication follower has logged ``offset`` (the replicated ack
+        floor, ISSUE 11: a frame is ACKed to the producer only once the
+        follower has it; the sender's ack-advance pokes the loop and
+        :meth:`EventLoop._pump_rack` releases the reply). ``parked``:
+        the caller is resolving an existing deferred op (pump path), so
+        an immediate answer must unpark instead of re-arming reads."""
+        repl = self.loop.repl_sender(self.queue)
+        if offset is not None and repl is not None and not repl.reached(offset):
+            self.pending = {"kind": "RA", "parts": parts, "offset": offset}
+            self.op_gen += 1
+            self.loop.add_rack_waiter(self)
+            return
+        self.send_parts(parts)
+        if parked:
+            self.unpark()
+        else:
+            self._await_op()
 
     def _put_payload(self) -> None:
         item = self._take_item()
@@ -593,11 +648,13 @@ class _EvConn:
         if self.srv._draining:
             self._send_control(_ST_CLOSED)
         else:
-            ok = self._try_put(item)
+            ok, offset = self._try_put(item)
+            if ok:
+                self.loop.queue_touched(self.queue)
+                self._answer_put([_ST_OK], offset)
+                return
             if ok is not None:
-                self._send_control(_ST_OK if ok else _ST_NO)
-                if ok:
-                    self.loop.queue_touched(self.queue)
+                self._send_control(_ST_NO)
         self._await_op()
 
     def _op_get(self) -> None:
@@ -683,14 +740,13 @@ class _EvConn:
             self._send_control(_ST_CLOSED)
             self._await_op()
             return
-        ok = self._try_put(item)
+        ok, offset = self._try_put(item)
         if ok is None:
             self._await_op()
             return
         if ok:
-            self._send_control(_ST_OK)
             self.loop.queue_touched(self.queue)
-            self._await_op()
+            self._answer_put([_ST_OK], offset)
             return
         if self._pw_wait_s <= 0:
             self._send_control(_ST_NO)
@@ -715,14 +771,15 @@ class _EvConn:
             self._send_control(_ST_CLOSED)
             self._await_op()
             return
-        ok = self._try_put(item)
+        ok, offset = self._try_put(item)
         if ok is None:
             self._await_op()
             return
         if ok:
-            self.send_parts([_ST_OK + struct.pack("<Q", self._w_seq)])
             self.loop.queue_touched(self.queue)
-            self._await_op()
+            self._answer_put(
+                [_ST_OK + struct.pack("<Q", self._w_seq)], offset
+            )
             return
         # backpressure: the ack is delayed until space frees — deferred
         # state with NO deadline (that delay IS the backpressure signal)
@@ -763,18 +820,20 @@ class _EvConn:
             self._await_op()
             return
         accepted = 0
+        high = None  # highest durable offset (offsets are monotonic)
         for item in batch:
-            ok = self._try_put(item)
+            ok, offset = self._try_put(item)
             if ok is None:  # refusal already answered ('X'/'E')
                 self._await_op()
                 return
             if not ok:
                 break  # full: accepted prefix only (FIFO)
             accepted += 1
-        self.send_parts([_ST_OK + struct.pack("<I", accepted)])
+            if offset is not None:
+                high = offset
         if accepted:
             self.loop.queue_touched(self.queue)
-        self._await_op()
+        self._answer_put([_ST_OK + struct.pack("<I", accepted)], high)
 
     def _op_stream(self) -> None:
         self._expect(4, self._stream_hdr)
@@ -1004,6 +1063,97 @@ class _EvConn:
         self.send_parts([_ST_OK + struct.pack("<H", len(nb)) + nb])
         self._await_op()
 
+    # -- replication opcodes ('H'/'V'/'Y', ISSUE 11) ----------------------
+    def _op_repl_open(self) -> None:
+        self._expect(2, self._ro_ns_len)
+
+    def _ro_ns_len(self) -> None:
+        (n,) = struct.unpack_from("<H", self._hdr)
+        self._open_buf = bytearray(n)
+        self._arm(memoryview(self._open_buf), self._ro_ns_done)
+
+    def _ro_ns_done(self) -> None:
+        self._open_ns = self._open_buf.decode()
+        self._expect(2, self._ro_nm_len)
+
+    def _ro_nm_len(self) -> None:
+        (n,) = struct.unpack_from("<H", self._hdr)
+        self._open_buf = bytearray(n)
+        self._arm(memoryview(self._open_buf), self._ro_finish)
+
+    def _ro_finish(self) -> None:
+        nm = self._open_buf.decode()
+        repl = self.srv.replication
+        entry = (
+            repl.replica_open(self._open_ns, nm) if repl is not None else None
+        )
+        if entry is None:
+            # cannot host this replica: no replication manager, the
+            # queue is mounted LIVE on this server, or the replica was
+            # already promoted — the fencing answer a zombie owner must
+            # treat as "stop replicating"
+            self._send_control(_ST_NO)
+        else:
+            self.replica = entry
+            FLIGHT.record(
+                "replica_subscribe", port=self.srv.port,
+                queue=f"{self._open_ns}/{nm}", tail=entry.log.next_offset,
+            )
+            self.send_parts(
+                [_ST_OK + struct.pack("<Q", entry.log.next_offset)]
+            )
+        self._await_op()
+
+    def _va_hdr(self) -> None:
+        self._v_off, self._v_floor = struct.unpack_from("<QQ", self._hdr)
+        (n,) = struct.unpack_from("<I", self._hdr, 16)
+        self._expect_payload(n, self._va_payload)
+
+    def _va_payload(self) -> None:
+        item = self._take_item()
+        try:
+            ok = self.srv.replication.replica_append(
+                self.replica, self._v_off, self._v_floor, item
+            )
+        except Exception:  # noqa: BLE001 — a replica disk fault answers
+            ok = False  # 'E' (breadcrumbed in storage); the loop lives
+        finally:
+            release = getattr(item, "release", None)
+            if release is not None:
+                release()  # the record is in the mmap now (or refused)
+        if ok:
+            self.send_parts([_ST_OK + struct.pack("<Q", self._v_off)])
+        else:
+            self._send_control(_ST_ERR)
+        self._await_op()
+
+    def _op_promote(self) -> None:
+        self._expect(2, self._pr_ns_len)
+
+    def _pr_ns_len(self) -> None:
+        (n,) = struct.unpack_from("<H", self._hdr)
+        self._open_buf = bytearray(n)
+        self._arm(memoryview(self._open_buf), self._pr_ns_done)
+
+    def _pr_ns_done(self) -> None:
+        self._open_ns = self._open_buf.decode()
+        self._expect(2, self._pr_nm_len)
+
+    def _pr_nm_len(self) -> None:
+        (n,) = struct.unpack_from("<H", self._hdr)
+        self._open_buf = bytearray(n)
+        self._arm(memoryview(self._open_buf), self._pr_finish)
+
+    def _pr_finish(self) -> None:
+        nm = self._open_buf.decode()
+        repl = self.srv.replication
+        rng = repl.promote(self._open_ns, nm) if repl is not None else None
+        if rng is None:
+            self._send_control(_ST_NO)  # no replica here: queue starts empty
+        else:
+            self.send_parts([_ST_OK + struct.pack("<QQ", rng[0], rng[1])])
+        self._await_op()
+
     def _op_open(self) -> None:
         self._expect(2, self._open_ns_len)
 
@@ -1054,6 +1204,8 @@ _OPS: Dict[int, str] = {
     _OP_REPLAY[0]: "_op_replay",
     _OP_COMMIT[0]: "_op_commit",
     _OP_CODEC[0]: "_op_codec",
+    _OP_REPL_OPEN[0]: "_op_repl_open",
+    _OP_PROMOTE[0]: "_op_promote",
     _OP_BYE[0]: "_op_bye",
 }
 
@@ -1101,6 +1253,12 @@ class EventLoop:
         if qs is None:
             qs = _QueueState(queue)
             self._queues[id(queue)] = qs
+            repl = getattr(self._srv, "replication", None)
+            if repl is not None:
+                # the queue's ReplicationSender (mounted at open_named
+                # time, strictly before any connection binds) — the
+                # replicated-ack-floor gate key
+                qs.repl = repl.sender_for(queue)
             add = getattr(queue, "add_listener", None)
             if add is not None:
                 try:
@@ -1130,6 +1288,16 @@ class EventLoop:
 
     def add_stream(self, conn: _EvConn) -> None:
         self._qs(conn.queue).get_waiters.append(conn)
+
+    def add_rack_waiter(self, conn: _EvConn) -> None:
+        """Park a producer whose reply waits on the replicated ack
+        floor (pending kind "RA"); no deadline — the sender's degrade
+        grace bounds the wait when the follower link is down."""
+        self._qs(conn.queue).ra_waiters.append(conn)
+
+    def repl_sender(self, queue):
+        """The queue's ReplicationSender, or None when unreplicated."""
+        return self._qs(queue).repl
 
     def add_liveness_probe(self, conn: _EvConn) -> None:
         """Re-check a parked, read-paused connection for EOF every
@@ -1295,7 +1463,7 @@ class EventLoop:
             t = min(t, max(0.0, self._timers[0][0] - now))
         waiting = unlistened = False
         for qs in self._queues.values():
-            if qs.get_waiters or qs.put_waiters:
+            if qs.get_waiters or qs.put_waiters or qs.ra_waiters:
                 waiting = True
                 if not qs.listened:
                     unlistened = True
@@ -1340,12 +1508,16 @@ class EventLoop:
     # -- the pump: serve waiters when queue state may have changed --------
     def _pump_all(self) -> None:
         for qs in list(self._queues.values()):
-            if not (qs.get_waiters or qs.put_waiters):
+            if not (qs.get_waiters or qs.put_waiters or qs.ra_waiters):
                 continue
             try:
                 progressed = True
                 while progressed:
-                    progressed = self._pump_get(qs) | self._pump_put(qs)
+                    progressed = (
+                        self._pump_get(qs)
+                        | self._pump_put(qs)
+                        | self._pump_rack(qs)
+                    )
             except _QueueClosedSignal:
                 self._queue_closed(qs)
 
@@ -1453,7 +1625,11 @@ class EventLoop:
                 pw.popleft()
                 continue
             try:
-                ok = qs.queue.put(conn.pending["item"])
+                put_offset = getattr(qs.queue, "put_offset", None)
+                if put_offset is not None:
+                    ok, offset = put_offset(conn.pending["item"])
+                else:
+                    ok, offset = qs.queue.put(conn.pending["item"]), None
             except TransportClosed:
                 raise _QueueClosedSignal from None
             except Exception as e:  # noqa: BLE001 — e.g. a durable queue
@@ -1472,13 +1648,42 @@ class EventLoop:
             if not ok:
                 break  # still full: FIFO — nobody behind may jump the line
             pw.popleft()
+            if conn.pending["kind"] == "W":
+                parts = [_ST_OK + struct.pack("<Q", conn.pending["seq"])]
+            else:
+                parts = [_ST_OK]
             try:
-                if conn.pending["kind"] == "W":
-                    conn.send_parts(
-                        [_ST_OK + struct.pack("<Q", conn.pending["seq"])]
-                    )
-                else:
-                    conn._send_control(_ST_OK)
+                # the reply may re-park on the replicated ack floor
+                # (pending flips U/W -> RA); parked=True resumes reads
+                # on the immediate-answer path
+                conn._answer_put(parts, offset, parked=True)
+            except (ConnectionError, OSError) as e:
+                self.kill_conn(conn, e)
+            did = True
+        return did
+
+    def _pump_rack(self, qs: _QueueState) -> bool:
+        """Release producer replies whose offsets the follower has
+        logged — or all of them once the sender degraded (follower link
+        down past the grace window). FIFO is offset order, so an
+        unreached head means nobody behind is reachable either."""
+        did = False
+        rw = qs.ra_waiters
+        while rw:
+            conn = rw[0]
+            if conn.closed or conn.pending is None or conn.pending.get(
+                "kind"
+            ) != "RA":
+                rw.popleft()
+                continue
+            if qs.repl is not None and not qs.repl.reached(
+                conn.pending["offset"]
+            ):
+                break
+            rw.popleft()
+            parts = conn.pending["parts"]
+            try:
+                conn.send_parts(parts)
                 conn.unpark()
             except (ConnectionError, OSError) as e:
                 self.kill_conn(conn, e)
@@ -1510,6 +1715,21 @@ class EventLoop:
                 continue
             try:
                 conn._send_control(_ST_CLOSED)
+                conn.unpark()
+            except (ConnectionError, OSError) as e:
+                self.kill_conn(conn, e)
+        while qs.ra_waiters:
+            # replicated-ack waiters: their frames WERE accepted and
+            # logged before the close — release the truthful OK reply
+            # rather than holding it against a floor that may never
+            # advance on a closed queue
+            conn = qs.ra_waiters.popleft()
+            if conn.closed or conn.pending is None or conn.pending.get(
+                "kind"
+            ) != "RA":
+                continue
+            try:
+                conn.send_parts(conn.pending["parts"])
                 conn.unpark()
             except (ConnectionError, OSError) as e:
                 self.kill_conn(conn, e)

@@ -71,6 +71,36 @@ Wire protocol (all little-endian):
                   — compression is an encoding, never a requirement).
                   Clients that never negotiate see byte-identical wire
                   traffic to pre-codec peers
+              'H' (replica-subscribe) + ns_len:u16 + ns + name_len:u16
+                  + name — replication (ISSUE 11): switch this
+                  connection to REPLICA mode for the named queue's
+                  replica log on a durable server. The response carries
+                  the replica's current tail so the owner's shipper
+                  resumes exactly there; from here the connection
+                  carries only 'V' appends and 'F'. '0' when this
+                  server cannot host the replica (no --durable_dir, the
+                  queue is mounted live here, or the replica was
+                  already promoted — the fencing answer a zombie owner
+                  sees after a failover)
+              'V' (replica-append) + offset:u64 + floor:u64 + len:u32
+                  + payload — one chain-replicated record at an
+                  explicit log offset (the owner's offset space is
+                  mirrored verbatim; divergence reconciles by
+                  truncate-to-offset, gaps by reset — both
+                  breadcrumbed). ``floor`` piggybacks the owner's live
+                  committed offset (u64 max = none) so a promoted
+                  replica re-exposes only the unacked window.
+                  Windowed like 'W': the owner pipelines appends and
+                  reads cumulative '1'+offset acks; the acked offset IS
+                  the replicated ack floor gating producer acks on the
+                  owner. 'E' = refused (promoted/fenced or disk fault)
+              'Y' (promote) + ns_len:u16 + ns + name_len:u16 + name —
+                  failover: finalize the named replica log on this
+                  server (fence further 'V' appends, flush, release the
+                  mapping) so the next OPEN mounts it as the LIVE
+                  durable queue, serving the replicated backlog and
+                  retained range. Answers the retained range; '0' when
+                  no replica exists here (the queue starts empty)
               'F' (bye) — no response; acks the last delivery and ends
                   the connection cleanly (see delivery contract below)
     response: status:u8 ('1' ok | '0' full/empty | 'X' closed | 'E' error)
@@ -84,6 +114,9 @@ Wire protocol (all little-endian):
               + [R ok] start:u64 + end:u64 (resolved cursor start and
                 the log tail at open time; the cursor follows the tail)
               + [Z ok] len:u16 + chosen codec name ("none" = stay raw)
+              + [H ok] tail:u64 (the replica log's next offset)
+              + [V ok] offset:u64 (cumulative replicated-ack floor)
+              + [Y ok] start:u64 + end:u64 (the promoted retained range)
     stream push (server -> client, after 'M'):
               status:u8 ('1') + seq:u64 + len:u32 + payload per frame;
               'X' when the bound queue closes (the stream is over)
@@ -174,7 +207,7 @@ the popped item(s).
 
 Server architecture (ISSUE 6): the server IS a single selectors/epoll
 readiness loop (:mod:`psana_ray_tpu.transport.evloop`) driving a
-per-connection state machine over all 19 opcodes — memory O(connections
+per-connection state machine over all 22 opcodes — memory O(connections
 x small struct), thread count independent of connection count, blocking
 waits ('W'/'U'/'D', stream credit stalls) held as timer/deferred-
 callback state instead of parked threads. The legacy thread-per-
@@ -195,6 +228,7 @@ and presents this module's transport contract unchanged.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
@@ -238,11 +272,17 @@ _OP_CLUSTER = b"N"
 _OP_REPLAY = b"R"
 _OP_COMMIT = b"J"
 _OP_CODEC = b"Z"
+_OP_REPL_OPEN = b"H"
+_OP_REPL_APPEND = b"V"
+_OP_PROMOTE = b"Y"
 _OP_BYE = b"F"
 _ST_OK = b"1"
 _ST_NO = b"0"
 _ST_CLOSED = b"X"
 _ST_ERR = b"E"
+# 'V' replica-append floor field sentinel: no committed floor to
+# piggyback (nothing consumed on the owner yet)
+_REPL_NO_FLOOR = (1 << 64) - 1
 
 # The longest one bounded-wait request ('D'/'U' timeout field) may defer
 # server-side: long enough that an idle consumer costs ~one round trip
@@ -558,7 +598,7 @@ class TcpQueueServer:
     docstring. Start with ``serve_background()``.
 
     The serving architecture is one epoll readiness loop with
-    per-connection state machines for all 19 opcodes, blocking waits as
+    per-connection state machines for all 22 opcodes, blocking waits as
     timer/deferred state (:mod:`psana_ray_tpu.transport.evloop`) —
     scales to thousands of streamed subscribers with O(1) threads. The
     legacy thread-per-connection mode was removed (ISSUE 7); ``mode``
@@ -582,6 +622,7 @@ class TcpQueueServer:
         mode: Optional[str] = None,
         max_conns: int = 0,
         group_store_path: Optional[str] = None,
+        replication=None,
     ):
         self.queue = queue if queue is not None else RingBuffer(maxsize)
         self._maxsize = maxsize
@@ -619,6 +660,15 @@ class TcpQueueServer:
         from psana_ray_tpu.cluster.coordinator import GroupRegistry
 
         self.groups = GroupRegistry(store_path=group_store_path)
+        # chain replication (ISSUE 11): a cluster.replication.
+        # ReplicationManager makes this server BOTH an owner that ships
+        # its durable queues' segment logs to their follower ('V' over a
+        # dedicated link, producer acks gated on the replicated floor)
+        # AND a follower hosting passive replica logs ('H'/'V' inbound,
+        # 'Y' promote on failover) — None = unreplicated, zero new cost
+        self.replication = replication
+        if replication is not None:
+            replication.attach(self)
 
     def open_named(self, namespace: str, queue_name: str, maxsize: Optional[int] = None):
         """Get-or-create the named queue (the OPEN opcode server-side;
@@ -628,10 +678,28 @@ class TcpQueueServer:
         with self._queues_lock:
             q = self._queues.get(key)
             if q is None:
+                if self.replication is not None:
+                    # an OPEN of a queue this server holds a REPLICA of
+                    # is a failover landing here: finalize the replica
+                    # log first (fence + unmap) so the durable factory's
+                    # recovery scan mounts the replicated backlog —
+                    # defense in depth behind the explicit 'Y' promote
+                    self.replication.ensure_promoted(namespace, queue_name)
                 q = self._queue_factory(namespace, queue_name, maxsize or self._maxsize)
                 self._queues[key] = q
+                if self.replication is not None:
+                    # owner half: if this server is in the partition's
+                    # chain with a next link, start shipping its log
+                    self.replication.queue_mounted(namespace, queue_name, q)
                 FLIGHT.record("queue_opened", namespace=namespace, name=queue_name)
             return q
+
+    def has_named_queue(self, namespace: str, queue_name: str) -> bool:
+        """Is ``(namespace, queue_name)`` mounted LIVE here? (The
+        replica-subscribe refusal check: a server never hosts a passive
+        replica of a queue it is serving.)"""
+        with self._queues_lock:
+            return (namespace, queue_name) in self._queues
 
     def named_queues(self) -> List[tuple]:
         with self._queues_lock:
@@ -738,6 +806,10 @@ class TcpQueueServer:
         t = getattr(self, "_accept_thread", None)
         if t is not None and t is not threading.current_thread():
             t.join(timeout=2.0)
+        if self.replication is not None:
+            # stop the shipping senders + coordinator sync and unmap the
+            # replica logs AFTER the loop is down (no more 'V' appends)
+            self.replication.shutdown()
         try:
             self._sock.close()
         except OSError:
@@ -978,7 +1050,14 @@ class TcpQueueClient:
             if deadline is not None and now >= deadline:
                 break
             if attempt:  # back off BETWEEN dials — never after the last
-                sleep_s = delay
+                # FULL JITTER (uniform over [0, envelope)): the envelope
+                # doubles per attempt but the actual sleep is randomized
+                # — a deterministic schedule makes every client that
+                # watched the same server die redial in LOCKSTEP, and
+                # after an owner death that stampede lands squarely on
+                # the freshly promoted follower (ISSUE 11); the spread
+                # is pinned by test_replication.py
+                sleep_s = random.uniform(0.0, delay)
                 if deadline is not None:
                     sleep_s = min(sleep_s, max(0.0, deadline - now))
                 time.sleep(sleep_s)
@@ -1509,6 +1588,38 @@ class TcpQueueClient:
             )
             return self._status() == _ST_OK
 
+        with self._lock:
+            return self._retrying(_do, deadline)
+
+    def promote(
+        self, namespace: str, queue_name: str, deadline: Optional[float] = None
+    ) -> Optional[dict]:
+        """Replication failover ('Y', ISSUE 11): ask this server to
+        promote its replica log for ``(namespace, queue_name)`` into the
+        live durable queue — sent by the cluster client against a
+        partition's new owner BEFORE opening it, so the promoted backlog
+        (and retained replay range) is what OPEN mounts. Returns
+        ``{"start", "end"}`` (the retained range) or None when the
+        server holds no replica (the partition starts empty there).
+        Control plane: fails fast like the probes."""
+        if self._stream is not None:  # would desync the push framing
+            return self._side_channel().promote(namespace, queue_name, deadline)
+        ns, nm = namespace.encode(), queue_name.encode()
+
+        def _do():
+            self._sock.sendall(
+                _OP_PROMOTE
+                + struct.pack("<H", len(ns)) + ns
+                + struct.pack("<H", len(nm)) + nm
+            )
+            st = self._status()
+            if st != _ST_OK:
+                return None
+            start, end = struct.unpack("<QQ", _recv_exact(self._sock, 16))
+            return {"start": start, "end": end}
+
+        if deadline is None:
+            deadline = time.monotonic() + self.PROBE_DEADLINE_S
         with self._lock:
             return self._retrying(_do, deadline)
 

@@ -18,6 +18,14 @@ local port and forward to a destination.
     you saved). Each direction has its own bucket, like a full-duplex
     link.
 
+:class:`DiskFaultInjector`
+    The storage sibling (ISSUE 11): arms the patchable disk-fault hook
+    in :mod:`psana_ray_tpu.storage.log` so segment appends/fsyncs raise
+    ``OSError`` (default ``ENOSPC``) after N successful ops — a failing
+    or full durable disk, injected without touching a real filesystem.
+    Context manager; the hook is process-wide, so use it around
+    in-process servers only.
+
 :class:`FaultProxy`
     Byte-counting fault injector. Faults are armed per direction
     (``"up"`` = client->server, ``"down"`` = server->client):
@@ -42,10 +50,59 @@ local port and forward to a destination.
 
 from __future__ import annotations
 
+import errno
+import os
 import socket
 import threading
 import time
 from collections import deque
+
+
+class DiskFaultInjector:
+    """Arm the storage layer's patchable disk-fault hook: after
+    ``ok_ops`` successful matching ops, every further matching op
+    raises ``OSError(err)`` until :meth:`disarm` (or context exit).
+
+    ``ops`` filters which hook sites fault (``"append"``, ``"sync"``).
+    The durable stack is expected to degrade LOUDLY — ``disk_fault``
+    flight breadcrumb + DURABLE counter + an 'E' answer to the
+    producer — and the serving loop must survive (pinned by
+    tests/test_replication.py)."""
+
+    def __init__(self, ok_ops: int = 0, err: int = errno.ENOSPC,
+                 ops=("append", "sync")):
+        self.ok_ops = ok_ops
+        self.err = err
+        self.ops = tuple(ops)
+        self.fired = 0
+        self._seen = 0
+        self._lock = threading.Lock()
+        self._armed = True
+
+    def __call__(self, op: str) -> None:
+        with self._lock:
+            if not self._armed or op not in self.ops:
+                return
+            self._seen += 1
+            if self._seen <= self.ok_ops:
+                return
+            self.fired += 1
+        raise OSError(self.err, f"{os.strerror(self.err)} (injected, op={op})")
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    def __enter__(self) -> "DiskFaultInjector":
+        from psana_ray_tpu.storage.log import set_disk_fault_hook
+
+        set_disk_fault_hook(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from psana_ray_tpu.storage.log import set_disk_fault_hook
+
+        set_disk_fault_hook(None)
 
 
 class DelayProxy:

@@ -1,0 +1,32 @@
+"""BAD (ISSUE 11): a replication half-protocol — the replica-append
+opcode is shipped by the owner's link but the follower's dispatch never
+matches it (the first 'V' on the wire is a runtime protocol error that
+kills the replication link), and the promote opcode has a dispatch arm
+nobody sends (dead failover surface: a replica that can never be
+promoted is a replica that never serves)."""
+
+_OP_RSUB = b"h"  # replica-subscribe: wired both ways (the control case)
+_OP_RAPP = b"v"  # replica-append: SENT below, never dispatched
+_OP_RPROMOTE = b"y"  # promote: dispatched below, never sent
+
+
+class Link:
+    def subscribe(self, sock, name):
+        sock.sendall(_OP_RSUB + name)
+
+    def ship(self, sock, offset, payload):
+        sock.sendall(_OP_RAPP + offset + payload)
+
+
+class Server:
+    def dispatch(self, op, conn):
+        if op == _OP_RSUB:
+            return self.open_replica(conn)
+        elif op == _OP_RPROMOTE:
+            return self.promote_replica(conn)
+
+    def open_replica(self, conn):
+        return conn
+
+    def promote_replica(self, conn):
+        return conn

@@ -1,0 +1,39 @@
+"""GOOD (ISSUE 11): the replication opcode triple with both arms —
+subscribe and append shipped by the owner's link, promote sent on the
+failover path, every one matched by a dispatch comparison."""
+
+_OP_RSUB = b"h"
+_OP_RAPP = b"v"
+_OP_RPROMOTE = b"y"
+
+
+class Link:
+    def subscribe(self, sock, name):
+        sock.sendall(_OP_RSUB + name)
+
+    def ship(self, sock, offset, payload):
+        sock.sendall(_OP_RAPP + offset + payload)
+
+
+class Failover:
+    def promote(self, sock, name):
+        sock.sendall(_OP_RPROMOTE + name)
+
+
+class Server:
+    def dispatch(self, op, conn):
+        if op == _OP_RSUB:
+            return self.open_replica(conn)
+        elif op == _OP_RAPP:
+            return self.append_replica(conn)
+        elif op == _OP_RPROMOTE:
+            return self.promote_replica(conn)
+
+    def open_replica(self, conn):
+        return conn
+
+    def append_replica(self, conn):
+        return conn
+
+    def promote_replica(self, conn):
+        return conn

@@ -244,7 +244,8 @@ def test_wire_protocol_checker_verifies_codec_opcode_both_ways():
         and n.targets[0].id == "_OP_CODEC"
         for n in tree.body
     ), "_OP_CODEC opcode constant missing from tcp.py"
-    result = run_lint(paths=[tcp, evloop], checkers=["wire-protocol"])
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
+    result = run_lint(paths=[tcp, evloop, repl], checkers=["wire-protocol"])
     assert not result.findings, result.findings
 
 
@@ -317,6 +318,7 @@ def test_wire_protocol_checker_verifies_anchor_opcode_both_ways():
 
     tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
     evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
     tree = ast.parse(tcp.read_text())
     assert any(
         isinstance(n, ast.Assign)
@@ -324,8 +326,9 @@ def test_wire_protocol_checker_verifies_anchor_opcode_both_ways():
         and n.targets[0].id == "_OP_ANCHOR"
         for n in tree.body
     ), "_OP_ANCHOR opcode constant missing from tcp.py"
-    # the generic checker sees it both ways across the protocol pair
-    result = run_lint(paths=[tcp, evloop], checkers=["wire-protocol"])
+    # the generic checker sees it both ways across the protocol set
+    # (replication.py carries the 'H'/'V' senders since ISSUE 11)
+    result = run_lint(paths=[tcp, evloop, repl], checkers=["wire-protocol"])
     assert not result.findings, result.findings
 
 
@@ -364,9 +367,11 @@ def test_wire_protocol_checker_verifies_streaming_opcodes_both_ways():
     ):
         assert op in defined, f"{op} opcode constant missing from tcp.py"
     # the generic checker sees every one both ways across the protocol
-    # pair (dispatch moved to evloop.py's _OPS table with ISSUE 7)
+    # set (dispatch moved to evloop.py's _OPS table with ISSUE 7; the
+    # replication senders live in cluster/replication.py since ISSUE 11)
     evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
-    result = run_lint(paths=[tcp, evloop], checkers=["wire-protocol"])
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
+    result = run_lint(paths=[tcp, evloop, repl], checkers=["wire-protocol"])
     assert not result.findings, result.findings
 
 
@@ -382,6 +387,7 @@ def test_wire_protocol_checker_verifies_cluster_opcode_both_ways():
 
     tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
     evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
     tree = ast.parse(tcp.read_text())
     assert any(
         isinstance(n, ast.Assign)
@@ -389,7 +395,7 @@ def test_wire_protocol_checker_verifies_cluster_opcode_both_ways():
         and n.targets[0].id == "_OP_CLUSTER"
         for n in tree.body
     ), "_OP_CLUSTER opcode constant missing from tcp.py"
-    result = run_lint(paths=[tcp, evloop], checkers=["wire-protocol"])
+    result = run_lint(paths=[tcp, evloop, repl], checkers=["wire-protocol"])
     assert not result.findings, result.findings
     # cross-file is load-bearing: without the dispatch table in scope,
     # every sent opcode (including 'N') must flag as never-matched
@@ -398,6 +404,97 @@ def test_wire_protocol_checker_verifies_cluster_opcode_both_ways():
         "_OP_CLUSTER" in f.message and "never matched" in f.message
         for f in alone.findings
     ), alone.findings
+
+
+def test_wire_protocol_checker_verifies_replication_opcodes_both_ways():
+    """ISSUE 11 satellite: the replication opcodes ('H' replica-
+    subscribe, 'V' replica-append, 'Y' promote) must stay wired on both
+    sides. The senders live in cluster/replication.py (the owner's
+    shipping link) and tcp.py (the failover promote), the dispatch in
+    evloop.py — which is exactly why replication.py is a PROTOCOL
+    companion: a scan without it must flag the phantom asymmetry rather
+    than pass silently."""
+    import ast
+
+    from psana_ray_tpu.lint.core import PROTOCOL_COMPANIONS
+
+    tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
+    evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
+    tree = ast.parse(tcp.read_text())
+    defined = {
+        n.targets[0].id
+        for n in tree.body
+        if isinstance(n, ast.Assign) and isinstance(n.targets[0], ast.Name)
+    }
+    for op in ("_OP_REPL_OPEN", "_OP_REPL_APPEND", "_OP_PROMOTE"):
+        assert op in defined, f"{op} opcode constant missing from tcp.py"
+    result = run_lint(paths=[tcp, evloop, repl], checkers=["wire-protocol"])
+    assert not result.findings, result.findings
+    # the cross-file senders are load-bearing: without replication.py
+    # in scope the replica opcodes look like dead dispatch surface —
+    # the reason it rides PROTOCOL_COMPANIONS into every --changed run
+    assert "psana_ray_tpu/cluster/replication.py" in PROTOCOL_COMPANIONS
+    without = run_lint(
+        paths=[tcp, evloop], checkers=["wire-protocol"], use_allowlist=False
+    )
+    asym = {
+        f.message.split()[1]
+        for f in without.findings
+        if "no code ever sends it" in f.message
+    }
+    assert {"_OP_REPL_OPEN", "_OP_REPL_APPEND"} <= asym, without.findings
+
+
+def test_replication_wire_fixture_pair():
+    """The seeded replication half-protocol flags both failure shapes
+    (append sent with no dispatch arm; promote dispatched with no
+    sender) and the complete triple passes."""
+    bad = FIXTURES / "replication_wire_bad.py"
+    result = run_lint(paths=[bad], checkers=["wire-protocol"], use_allowlist=False)
+    msgs = [f.message for f in result.findings]
+    assert any(
+        "_OP_RAPP" in m and "never matched" in m for m in msgs
+    ), msgs
+    assert any(
+        "_OP_RPROMOTE" in m and "no code ever sends it" in m for m in msgs
+    ), msgs
+    good = FIXTURES / "replication_wire_good.py"
+    result = run_lint(paths=[good], checkers=["wire-protocol"], use_allowlist=False)
+    assert not result.findings, result.findings
+
+
+def test_segment_lifecycle_covers_the_follower_truncate_path():
+    """ISSUE 11 satellite: the replica reconciliation surface —
+    SegmentLog.truncate_to / reset_to pop, close and re-mint segments —
+    must stay clean under the segment-lifecycle checker (a leaked
+    mapping per truncate would pin an mmap per owner reconnect)."""
+    log = REPO_ROOT / "psana_ray_tpu" / "storage" / "log.py"
+    seg = REPO_ROOT / "psana_ray_tpu" / "storage" / "segment.py"
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
+    result = run_lint(
+        paths=[log, seg, repl], checkers=["segment-lifecycle"]
+    )
+    assert not result.findings, result.findings
+    # ...and the checker is not inert on this population: a seeded
+    # truncate that drops the popped segment must flag
+    import textwrap
+
+    snippet = FIXTURES / "_repl_truncate_leak.py"
+    snippet.write_text(textwrap.dedent("""
+        class Log:
+            def truncate_to(self, offset):
+                seg = self._new_segment(offset)
+                self.tail = offset
+    """))
+    try:
+        result = run_lint(
+            paths=[snippet], checkers=["segment-lifecycle"],
+            use_allowlist=False,
+        )
+        assert result.findings, "seeded truncate leak did not flag"
+    finally:
+        snippet.unlink()
 
 
 def test_blocking_checker_covers_the_stream_reader_path():
@@ -546,17 +643,18 @@ def test_flow_layer_protocol_pair_scans_clean_and_reconstructs():
     tcp = REPO_ROOT / "psana_ray_tpu" / "transport" / "tcp.py"
     evloop = REPO_ROOT / "psana_ray_tpu" / "transport" / "evloop.py"
     codec = REPO_ROOT / "psana_ray_tpu" / "transport" / "codec.py"
+    repl = REPO_ROOT / "psana_ray_tpu" / "cluster" / "replication.py"
     result = run_lint(
-        paths=[tcp, evloop, codec],
+        paths=[tcp, evloop, codec, repl],
         checkers=["protocol-dialogue", "lockset-inference", "resource-flow"],
     )
     assert not result.findings, result.findings
 
-    index = ProjectIndex([tcp, evloop])
+    index = ProjectIndex([tcp, evloop, repl])
     d = extract_dialogue(index)
     assert d is not None
     # every dispatched opcode has a server handler AND a client sender
-    assert len(d["ops"]) >= 18  # the 19-opcode protocol, 'K' acked in-dispatch
+    assert len(d["ops"]) >= 20  # 22 opcodes; 'K'/'V' acked in-dispatch
     for op, rec in d["ops"].items():
         assert not rec["handler_missing"], op
         assert rec["senders"], f"{op} has no client sender"
@@ -570,6 +668,12 @@ def test_flow_layer_protocol_pair_scans_clean_and_reconstructs():
     assert replay["opened_by"] == "_OP_REPLAY"
     assert "_OP_STREAM" in replay["illegal_ops"]
     assert replay["client_attr"] == "_replay_args"
+    # replica links (ISSUE 11) carry exactly append + bye — the
+    # legal-op set pinned the same way as stream/replay modes
+    replica = d["modes"]["replica"]
+    assert replica["opened_by"] == "_OP_REPL_OPEN"
+    assert replica["server_allowed"] == {"_OP_REPL_APPEND", "_OP_BYE"}
+    assert replica["client_attr"] == "_stream"
 
 
 def test_protocol_dialogue_flags_seeded_desync():

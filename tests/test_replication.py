@@ -1,0 +1,855 @@
+"""Replicated partition logs + coordinator leases (ISSUE 11).
+
+Layers, fast to slow:
+
+- follower placement is the rendezvous ranking (the chain property:
+  the failover target of a dead owner IS its replica holder);
+- SegmentLog reconciliation primitives (append_at / truncate_to /
+  reset_to) survive reopen;
+- ReplicaSet ingest semantics: overlap truncates, gaps reset, floors
+  commit, promotion fences;
+- two-server end-to-end: the replicated ack floor (a producer ack
+  means the follower logged it), loud degrade when the follower link
+  is down, owner death -> promote -> the follower serves the backlog
+  and the replay range — including after the owner's DISK is deleted;
+- cluster failover with groups: kill the coordinator AND delete its
+  durable dir mid-run; lost == 0, the group's generation/drained
+  state survives on the failed-over coordinator (stale-generation
+  commits still fenced), replay still serves the retained range;
+- the full-jitter reconnect backoff spread (ISSUE 11 satellite);
+- a failing durable disk degrades loudly ('E' + breadcrumb), never
+  kills the event loop (ISSUE 11 satellite, DiskFaultInjector);
+- slow: a 3-server chaos loop (kill-and-restart a random server under
+  open-loop load, once deleting its disk) with zero loss.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.cluster.hashring import (
+    PartitionMap,
+    next_in_chain,
+    partition_follower,
+    partition_owner,
+    ranked_owners,
+)
+from psana_ray_tpu.cluster.replication import (
+    ReplicaSet,
+    ReplicationManager,
+    parse_partition,
+)
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.records import EndOfStream, FrameRecord, is_eos
+from psana_ray_tpu.storage import DurableRingBuffer, SegmentLog
+from psana_ray_tpu.transport.registry import TransportClosed
+from psana_ray_tpu.transport.tcp import (
+    _REPL_NO_FLOOR,
+    TcpQueueClient,
+    TcpQueueServer,
+)
+
+from faultproxy import DiskFaultInjector
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _frame(i: int, shape=(2, 8, 8)) -> FrameRecord:
+    panels = np.full(shape, i % 4096, dtype=np.uint16)
+    return FrameRecord(0, i, panels, 1.0)
+
+
+def _pick_queue_name(peers, owner: str, prefix: str = "rq") -> str:
+    """A queue name whose rank-0 owner (partition 0) is ``owner`` —
+    keeps the two-server tests deterministic about who ships where."""
+    for i in range(512):
+        name = f"{prefix}_{i}"
+        if partition_owner(peers, name, 0) == owner:
+            return name
+    raise AssertionError("no suitable queue name in 512 tries")
+
+
+def _durable_factory(durable_dir, fsync="none", segment_bytes=1 << 20):
+    def factory(ns, name, maxsize):
+        qdir = os.path.join(durable_dir, f"{ns}__{name}")
+        log = SegmentLog(
+            qdir, segment_bytes=segment_bytes, fsync=fsync,
+            name=f"{ns}/{name}",
+        )
+        return DurableRingBuffer(log, maxsize=maxsize, name=f"{ns}__{name}")
+
+    return factory
+
+
+def _replicated_server(durable_dir, peers, advertise, port,
+                       group_store=False, **mgr_kw):
+    mgr = ReplicationManager(durable_dir, peers, advertise, **mgr_kw)
+    srv = TcpQueueServer(
+        host="127.0.0.1", port=port, maxsize=256,
+        queue_factory=_durable_factory(durable_dir),
+        replication=mgr,
+        group_store_path=(
+            os.path.join(durable_dir, "groups.json") if group_store else None
+        ),
+    )
+    return srv.serve_background()
+
+
+# ---------------------------------------------------------------------------
+# follower placement: the chain IS the rendezvous ranking
+# ---------------------------------------------------------------------------
+class TestFollowerPlacement:
+    PEERS = ["h1:1", "h2:2", "h3:3", "h4:4"]
+
+    def test_ranking_is_deterministic_and_total(self):
+        for p in range(8):
+            ranked = ranked_owners(self.PEERS, "q", p)
+            assert sorted(ranked) == sorted(self.PEERS)
+            assert ranked == ranked_owners(list(reversed(self.PEERS)), "q", p)
+            assert ranked[0] == partition_owner(self.PEERS, "q", p)
+            assert ranked[1] == partition_follower(self.PEERS, "q", p)
+
+    def test_follower_is_the_failover_target(self):
+        """The property the whole design leans on: when the owner dies,
+        the recomputed map hands the partition to the server already
+        holding its replica."""
+        m = PartitionMap.compute(self.PEERS, "q", 8)
+        for p in range(8):
+            owner = m.assignments[p]
+            follower = m.follower_of(p)
+            assert follower is not None and follower != owner
+            survivors = [s for s in self.PEERS if s != owner]
+            assert partition_owner(survivors, "q", p) == follower
+
+    def test_next_in_chain_walks_the_ranking(self):
+        ranked = ranked_owners(self.PEERS, "q", 3)
+        for i, server in enumerate(ranked):
+            nxt = next_in_chain(self.PEERS, server, "q", 3)
+            if i + 1 < len(ranked):
+                assert nxt == ranked[i + 1]
+            else:
+                assert nxt is None
+        assert next_in_chain(self.PEERS, "h9:9", "q", 3) is None
+
+    def test_single_server_has_no_follower(self):
+        assert partition_follower(["h1:1"], "q", 0) is None
+
+    def test_parse_partition(self):
+        assert parse_partition("shared_queue#p3") == ("shared_queue", 3)
+        assert parse_partition("plain") == ("plain", 0)
+        assert parse_partition("odd#px") == ("odd#px", 0)
+
+
+# ---------------------------------------------------------------------------
+# SegmentLog reconciliation primitives
+# ---------------------------------------------------------------------------
+class TestLogReconciliation:
+    def _log(self, tmp_path, name="l", **kw):
+        kw.setdefault("segment_bytes", 4096)
+        kw.setdefault("fsync", "none")
+        return SegmentLog(str(tmp_path / name), **kw)
+
+    def test_truncate_to_mid_segment_and_reappend(self, tmp_path):
+        log = self._log(tmp_path)
+        for i in range(10):
+            log.append({"i": i})
+        log.truncate_to(6)
+        assert log.next_offset == 6
+        assert log.read(5) == {"i": 5}
+        with pytest.raises(KeyError):
+            log.read(6)
+        # the tail is clean: appends continue exactly at the cut
+        assert log.append({"i": "new6"}) == 6
+        log.close()
+        # ...and a recovery scan agrees (no torn tail from the scrub)
+        log2 = self._log(tmp_path)
+        assert log2.next_offset == 7
+        assert log2.read(6) == {"i": "new6"}
+        assert not log2.torn_tail_repaired
+        log2.close()
+
+    def test_truncate_across_segments(self, tmp_path):
+        log = self._log(tmp_path, segment_bytes=512)
+        payload = {"pad": "x" * 100}
+        for i in range(12):
+            log.append(dict(payload, i=i))
+        assert len(log.stats()["committed"]) == 0
+        assert log.stats()["segments"] > 1
+        log.truncate_to(3)
+        assert log.next_offset == 3
+        assert log.read(2)["i"] == 2
+        for i in range(3, 6):
+            assert log.append(dict(payload, i=i)) == i
+        log.close()
+        log2 = self._log(tmp_path, segment_bytes=512)
+        assert log2.next_offset == 6
+        assert [log2.read(i)["i"] for i in range(6)] == list(range(6))
+        log2.close()
+
+    def test_reset_to_starts_a_new_offset_space(self, tmp_path):
+        log = self._log(tmp_path)
+        for i in range(5):
+            log.append({"i": i})
+        log.reset_to(100)
+        assert log.next_offset == 100
+        assert log.first_retained_offset() == 100
+        assert log.append_at(100, {"i": 100}) == 100
+        log.close()
+        log2 = self._log(tmp_path)
+        assert log2.next_offset == 101
+        assert log2.read(100) == {"i": 100}
+        log2.close()
+
+    def test_append_at_enforces_contiguity(self, tmp_path):
+        log = self._log(tmp_path)
+        log.append_at(0, {"i": 0})
+        with pytest.raises(ValueError, match="out of order"):
+            log.append_at(5, {"i": 5})
+        with pytest.raises(ValueError, match="out of order"):
+            log.append_at(0, {"i": 0})
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSet ingest semantics
+# ---------------------------------------------------------------------------
+class TestReplicaSetIngest:
+    def test_ingest_overlap_truncates_and_gap_resets(self, tmp_path):
+        rs = ReplicaSet(str(tmp_path), segment_bytes=1 << 16, fsync="none")
+        entry = rs.subscribe_log("ns", "q")
+        assert entry is not None
+        for i in range(6):
+            assert rs.ingest(entry, i, _REPL_NO_FLOOR, {"i": i})
+        # overlap: the owner's view of the suffix wins
+        assert rs.ingest(entry, 4, _REPL_NO_FLOOR, {"i": "re4"})
+        assert entry.log.next_offset == 5
+        assert entry.log.read(4) == {"i": "re4"}
+        # forward gap: retention passed us -> reset, loudly
+        assert rs.ingest(entry, 50, _REPL_NO_FLOOR, {"i": 50})
+        assert entry.log.first_retained_offset() == 50
+        assert entry.log.next_offset == 51
+        rs.close_all()
+
+    def test_floor_commits_ride_with_stride_and_promote_is_exact(self, tmp_path):
+        rs = ReplicaSet(str(tmp_path), segment_bytes=1 << 16, fsync="none")
+        entry = rs.subscribe_log("ns", "q")
+        for i in range(8):
+            rs.ingest(entry, i, floor=i - 2, item={"i": i})
+        # stride (32) not reached: nothing committed yet
+        assert entry.log.committed("") == -1
+        rng = rs.promote("ns", "q")
+        assert rng == (0, 8)
+        # promotion committed the exact latest piggybacked floor
+        reopened = SegmentLog(str(tmp_path / "ns__q"), fsync="none")
+        assert reopened.committed("") == 5
+        reopened.close()
+
+    def test_promotion_fences_ingest_and_resubscribe(self, tmp_path):
+        rs = ReplicaSet(str(tmp_path), segment_bytes=1 << 16, fsync="none")
+        entry = rs.subscribe_log("ns", "q")
+        assert rs.ingest(entry, 0, _REPL_NO_FLOOR, {"i": 0})
+        assert rs.promote("ns", "q") is not None
+        assert rs.promote("ns", "q") is None  # second promote: nothing left
+        assert not rs.ingest(entry, 1, _REPL_NO_FLOOR, {"i": 1})  # fenced
+        assert rs.subscribe_log("ns", "q") is None  # zombie resubscribe
+
+
+# ---------------------------------------------------------------------------
+# two-server end-to-end: ack floor, degrade, promote
+# ---------------------------------------------------------------------------
+class TestReplicationEndToEnd:
+    def _pair(self, tmp_path, **mgr_kw):
+        dirs = [str(tmp_path / f"s{i}") for i in range(2)]
+        for d in dirs:
+            os.makedirs(d, exist_ok=True)
+        ports = [_free_port(), _free_port()]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        servers = [
+            _replicated_server(dirs[i], peers, peers[i], ports[i], **mgr_kw)
+            for i in range(2)
+        ]
+        return dirs, ports, peers, servers
+
+    def test_flush_means_follower_logged_and_promote_serves(self, tmp_path):
+        dirs, ports, peers, servers = self._pair(tmp_path)
+        try:
+            qname = _pick_queue_name(peers, peers[0])
+            c = TcpQueueClient(
+                "127.0.0.1", ports[0], namespace="ns", queue_name=qname
+            )
+            n = 24
+            for i in range(n):
+                assert c.put_pipelined(
+                    _frame(i), deadline=time.monotonic() + 30
+                )
+            assert c.flush_puts(time.monotonic() + 30)
+            # consume-and-ack a few on the owner: the committed floor
+            # piggybacks onto later appends/promote
+            got = c.get_batch(4, timeout=5.0)
+            assert len(got) == 4
+            c.disconnect()
+            # flush returned: every frame is follower-acked — its
+            # replica log holds ALL of them (the replicated ack floor)
+            servers[1].shutdown()  # releases the replica mmap
+            rlog = SegmentLog(
+                os.path.join(dirs[1], f"ns__{qname}"), fsync="none"
+            )
+            assert rlog.next_offset == n
+            assert not rlog.torn_tail_repaired
+            rlog.close()
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_owner_death_promote_serves_backlog_and_replay(self, tmp_path):
+        dirs, ports, peers, servers = self._pair(tmp_path)
+        try:
+            qname = _pick_queue_name(peers, peers[0])
+            c = TcpQueueClient(
+                "127.0.0.1", ports[0], namespace="ns", queue_name=qname
+            )
+            n = 16
+            for i in range(n):
+                assert c.put_pipelined(
+                    _frame(i), deadline=time.monotonic() + 30
+                )
+            assert c.flush_puts(time.monotonic() + 30)
+            c.disconnect()
+            # kill the owner AND delete its disk: the bytes now exist
+            # ONLY on the follower
+            servers[0].shutdown()
+            shutil.rmtree(dirs[0])
+            c2 = TcpQueueClient("127.0.0.1", ports[1])
+            rng = c2.promote("ns", qname)
+            assert rng is not None and rng["end"] == n
+            c2.open("ns", qname, 256)
+            drained = []
+            while True:
+                batch = c2.get_batch(64, timeout=2.0)
+                if not batch:
+                    break
+                drained.extend(batch)
+            assert sorted(r.event_idx for r in drained) == list(range(n))
+            c2.disconnect()
+            # the promoted queue still serves the retained range as a
+            # non-destructive replay
+            c3 = TcpQueueClient(
+                "127.0.0.1", ports[1], namespace="ns", queue_name=qname
+            )
+            rng2 = c3.replay_open(from_offset="begin", group="audit")
+            assert rng2["end"] - rng2["start"] == n
+            replayed = []
+            while True:
+                batch = c3.get_batch(64, timeout=1.0)
+                if not batch:
+                    break
+                replayed.extend(batch)
+            assert len(replayed) == n
+            c3.disconnect()
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_owner_restarted_behind_replica_is_fenced_not_rewound(
+        self, tmp_path
+    ):
+        """A server that comes back with an emptied disk while its
+        replica holds acked records must NOT rewind the replica to
+        mirror its empty log (that would destroy the only surviving
+        copy): the owner fences itself, loudly, and serves degraded."""
+        dirs, ports, peers, servers = self._pair(
+            tmp_path, degrade_after_s=0.5
+        )
+        try:
+            qname = _pick_queue_name(peers, peers[0])
+            c = TcpQueueClient(
+                "127.0.0.1", ports[0], namespace="ns", queue_name=qname
+            )
+            n = 12
+            for i in range(n):
+                assert c.put_pipelined(
+                    _frame(i), deadline=time.monotonic() + 30
+                )
+            assert c.flush_puts(time.monotonic() + 30)
+            c.disconnect()
+            # the machine "loses its disk" but comes back FAST — before
+            # any client wrote it off
+            servers[0].shutdown()
+            shutil.rmtree(dirs[0])
+            os.makedirs(dirs[0])
+            servers[0] = _replicated_server(
+                dirs[0], peers, peers[0], ports[0], degrade_after_s=0.5
+            )
+            fenced_before = FLIGHT.count_of("replication_fenced")
+            c2 = TcpQueueClient(
+                "127.0.0.1", ports[0], namespace="ns", queue_name=qname
+            )
+            # the restarted owner serves (degraded once fenced) ...
+            assert c2.put_wait(_frame(99), timeout=15.0)
+            deadline = time.monotonic() + 10
+            while (
+                FLIGHT.count_of("replication_fenced") == fenced_before
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert FLIGHT.count_of("replication_fenced") > fenced_before
+            c2.disconnect()
+            # ... and the follower's replica survived untouched
+            servers[1].shutdown()
+            rlog = SegmentLog(
+                os.path.join(dirs[1], f"ns__{qname}"), fsync="none"
+            )
+            assert rlog.next_offset == n
+            rlog.close()
+        finally:
+            for s in servers:
+                if s is not None:
+                    s.shutdown()
+
+    def test_acks_held_until_follower_logs(self, tmp_path):
+        """The replicated ack floor, pinned directly: with the follower
+        ABSENT and a long degrade grace, windowed puts stay
+        unacknowledged; once the grace lapses the owner degrades loudly
+        and acks flow."""
+        d = str(tmp_path / "owner")
+        os.makedirs(d)
+        port = _free_port()
+        dead_port = _free_port()  # nothing ever listens here
+        peers = [f"127.0.0.1:{port}", f"127.0.0.1:{dead_port}"]
+        srv = _replicated_server(
+            d, peers, peers[0], port, degrade_after_s=1.0
+        )
+        try:
+            qname = _pick_queue_name(peers, peers[0])
+            c = TcpQueueClient(
+                "127.0.0.1", port, namespace="ns", queue_name=qname,
+                put_window=4,
+            )
+            t0 = time.monotonic()
+            assert c.put_pipelined(_frame(0), deadline=t0 + 30)
+            # held: the follower never acked, and the grace has not
+            # lapsed — a short flush deadline must expire
+            assert not c.flush_puts(time.monotonic() + 0.3)
+            # ...then the degrade opens the gate, loudly
+            assert c.flush_puts(time.monotonic() + 10.0)
+            assert time.monotonic() - t0 >= 0.9
+            assert FLIGHT.count_of("replication_degraded") >= 1
+            c.disconnect()
+        finally:
+            srv.shutdown()
+
+
+    def test_hung_follower_degrades_after_grace(self, tmp_path):
+        """A follower that ACCEPTS the connection but stops acking
+        (hung peer / blackholed link after the window filled) must hit
+        the same degrade grace as a refused dial — producers never
+        wedge behind a connected-but-silent follower."""
+        from faultproxy import FaultProxy
+
+        d0, d1 = str(tmp_path / "o"), str(tmp_path / "f")
+        os.makedirs(d0)
+        os.makedirs(d1)
+        oport, fport = _free_port(), _free_port()
+        proxy = FaultProxy("127.0.0.1", fport)
+        peers = [f"127.0.0.1:{oport}", f"127.0.0.1:{proxy.port}"]
+        owner = _replicated_server(
+            d0, peers, peers[0], oport, degrade_after_s=1.0
+        )
+        follower = _replicated_server(d1, peers, peers[1], fport)
+        try:
+            qname = _pick_queue_name(peers, peers[0])
+            # let the subscribe exchange through, then stall the
+            # owner->follower direction mid-first-append, forever
+            proxy.stall_at("up", 256, stall_s=120.0)
+            degr0 = FLIGHT.count_of("replication_degraded")
+            c = TcpQueueClient(
+                "127.0.0.1", oport, namespace="ns", queue_name=qname
+            )
+            for i in range(6):
+                assert c.put_pipelined(
+                    _frame(i), deadline=time.monotonic() + 30
+                )
+            assert c.flush_puts(time.monotonic() + 20)
+            assert FLIGHT.count_of("replication_degraded") > degr0
+            c.disconnect()
+        finally:
+            owner.shutdown()
+            follower.shutdown()
+            proxy.close()
+
+
+def test_unknown_replica_codec_fails_fast(tmp_path):
+    """An unknown --replica_codec must die at manager construction —
+    raising inside the shipper thread instead would kill it silently
+    and leave the replicated ack floor gating producers forever."""
+    with pytest.raises(ValueError):
+        ReplicationManager(
+            str(tmp_path), ["a:1", "b:2"], "a:1", codec="no-such-codec"
+        )
+
+
+# ---------------------------------------------------------------------------
+# cluster failover: kill the coordinator AND delete its disk
+# ---------------------------------------------------------------------------
+class TestClusterFailover:
+    def test_kill_coordinator_and_delete_disk_loses_nothing(self, tmp_path):
+        from psana_ray_tpu.cluster.client import ClusterClient
+
+        N, P, NF = 3, 4, 60
+        dirs = [str(tmp_path / f"s{i}") for i in range(N)]
+        for d in dirs:
+            os.makedirs(d)
+        ports = [_free_port() for _ in range(N)]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        servers = [
+            _replicated_server(
+                dirs[i], peers, peers[i], ports[i], group_store=True
+            )
+            for i in range(N)
+        ]
+        prod = cons = None
+        try:
+            prod = ClusterClient(
+                peers, queue_name="cq", n_partitions=P, maxsize=256,
+                retain=256, reconnect_tries=1, reconnect_base_s=0.05,
+            )
+            cons = ClusterClient(
+                peers, queue_name="cq", n_partitions=P, maxsize=256,
+                group="g1", reconnect_tries=1, reconnect_base_s=0.05,
+            )
+            err = {}
+
+            def produce():
+                try:
+                    for i in range(NF):
+                        assert prod.put_pipelined(
+                            _frame(i), deadline=time.monotonic() + 60
+                        ), i
+                        if i == NF // 3:
+                            # the acceptance move: kill the COORDINATOR
+                            # (server 0) and delete its durable dir —
+                            # its partitions AND the group state must
+                            # both survive
+                            servers[0].shutdown()
+                            shutil.rmtree(dirs[0])
+                    assert prod.flush_puts(time.monotonic() + 60)
+                    assert prod.put_wait(
+                        EndOfStream(0, -1, 1, 1), timeout=60
+                    )
+                except BaseException as e:  # noqa: BLE001 — reported below
+                    err["e"] = e
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            seen, eos = [], 0
+            deadline = time.monotonic() + 120
+            while not eos and time.monotonic() < deadline:
+                if "e" in err:
+                    raise err["e"]
+                for item in cons.get_batch_stream(32, timeout=0.5):
+                    if is_eos(item):
+                        eos += 1
+                    else:
+                        seen.append(item.event_idx)
+            t.join(10)
+            if "e" in err:
+                raise err["e"]
+            assert eos == 1, "group EOS never fired after the failover"
+            lost = sorted(set(range(NF)) - set(seen))
+            assert not lost, f"LOST {len(lost)}: {lost[:10]}"
+            # the coordinator's group state survived the failover:
+            # generation continued and a stale-generation commit from a
+            # zombie member is FENCED, not applied
+            info = cons._rpc({"op": "info", "group": "g1"})
+            assert info["ok"] and len(info["drained"]) == P
+            stale = cons._rpc({
+                "op": "drained", "group": "g1", "member": "zombie",
+                "generation": info["generation"] - 1, "partition": 0,
+            })
+            assert stale.get("fenced"), stale
+            # replay still serves a retained range from the promoted
+            # partitions (partition logs survived the deleted disk)
+            replayer = ClusterClient(
+                [a for a in peers if a != peers[0]],
+                queue_name="cq", n_partitions=P, maxsize=256,
+                reconnect_tries=1, reconnect_base_s=0.05,
+            )
+            try:
+                replayer.replay_open(from_offset="begin", group="audit")
+                replayed = []
+                empty_reads = 0
+                while empty_reads < 3:
+                    batch = replayer.get_batch(64, timeout=1.0)
+                    if batch:
+                        replayed.extend(
+                            b for b in batch if not is_eos(b)
+                        )
+                        empty_reads = 0
+                    else:
+                        empty_reads += 1
+                assert len({r.event_idx for r in replayed}) >= NF // 2
+            finally:
+                replayer.disconnect()
+        finally:
+            for c in (prod, cons):
+                if c is not None:
+                    try:
+                        c.disconnect()
+                    except Exception:
+                        pass
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# satellite: full-jitter reconnect backoff
+# ---------------------------------------------------------------------------
+class TestReconnectJitter:
+    def test_backoff_sleeps_are_jittered_not_lockstep(self, monkeypatch):
+        """Every backoff sleep draws uniform from [0, envelope) — three
+        clients that watched the same server die must NOT redial in
+        lockstep (the thundering herd that would land on a freshly
+        promoted follower)."""
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+        port = _free_port()  # nothing listens: every dial fails fast
+        per_client = []
+        for _ in range(3):
+            before = len(sleeps)
+            with pytest.raises(TransportClosed):
+                TcpQueueClient(
+                    "127.0.0.1", port, timeout_s=0.2,
+                    reconnect_tries=5, reconnect_base_s=0.05,
+                )
+            per_client.append(sleeps[before:])
+        caps = [0.05, 0.1, 0.2, 0.4]  # envelope per between-dial pause
+        for client_sleeps in per_client:
+            assert len(client_sleeps) == len(caps)
+            for s, cap in zip(client_sleeps, caps):
+                assert 0.0 <= s < cap  # strict: uniform never hits the cap
+        # spread across clients: the first pause differs client-to-client
+        firsts = [cs[0] for cs in per_client]
+        assert len(set(firsts)) == len(firsts), firsts
+
+
+# ---------------------------------------------------------------------------
+# satellite: a failing durable disk degrades loudly, never kills the loop
+# ---------------------------------------------------------------------------
+class TestDiskFaultDegradesLoudly:
+    def test_enospc_answers_E_and_loop_survives(self, tmp_path):
+        srv = TcpQueueServer(
+            host="127.0.0.1", port=0, maxsize=64,
+            queue_factory=_durable_factory(str(tmp_path)),
+        ).serve_background()
+        try:
+            c = TcpQueueClient(
+                "127.0.0.1", srv.port, namespace="ns", queue_name="dq",
+            )
+            assert c.put(_frame(0))  # healthy disk baseline
+            faults_before = FLIGHT.count_of("disk_fault")
+            with DiskFaultInjector() as inj:
+                # the full disk is a protocol ANSWER ('E'), not a
+                # connection death, and not a loop death
+                with pytest.raises(RuntimeError, match="protocol error"):
+                    c.put(_frame(1))
+                assert inj.fired >= 1
+                assert FLIGHT.count_of("disk_fault") > faults_before
+                # the loop is alive mid-fault: reads still serve
+                assert c.size() >= 1
+            # disk recovered: puts flow again and everything drains
+            assert c.put(_frame(2))
+            got = c.get_batch(16, timeout=5.0)
+            assert sorted(r.event_idx for r in got) == [0, 2]
+            c.disconnect()
+        finally:
+            srv.shutdown()
+
+    def test_injector_arms_after_n_ok_ops(self, tmp_path):
+        log = SegmentLog(str(tmp_path / "l"), fsync="none")
+        with DiskFaultInjector(ok_ops=2, ops=("append",)):
+            log.append({"i": 0})
+            log.append({"i": 1})
+            with pytest.raises(OSError):
+                log.append({"i": 2})
+        assert log.append({"i": 3}) == 2  # offset 2 was never consumed
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# slow: chaos — kill-and-restart a random server under open-loop load
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestChaosKillRestart:
+    def test_three_server_chaos_loses_nothing(self, tmp_path):
+        """Repeated kill-and-restart under open-loop load, one victim
+        losing its DISK. Two distinct failure shapes, matching the
+        documented failover contract:
+
+        - an intact-disk victim restarts FAST: clients ride the
+          reconnect envelope (no death verdict), the recovered log
+          re-exposes, the windowed resend covers the gap;
+        - the deleted-disk victim is a dead MACHINE: it stays down
+          until both clients have written it off (per-client-permanent
+          verdict) and its partitions serve from promoted replicas.
+          A fast restart with an emptied disk would instead be fenced
+          by the owner-behind-replica refusal — pinned separately.
+        """
+        import random as _random
+
+        from psana_ray_tpu.cluster.client import ClusterClient
+
+        rng = _random.Random(1311)
+        N, P, NF = 3, 4, 240
+        dirs = [str(tmp_path / f"s{i}") for i in range(N)]
+        for d in dirs:
+            os.makedirs(d)
+        ports = [_free_port() for _ in range(N)]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+
+        def boot(i):
+            return _replicated_server(
+                dirs[i], peers, peers[i], ports[i], group_store=True
+            )
+
+        servers = [boot(i) for i in range(N)]
+        prod = cons = None
+        try:
+            prod = ClusterClient(
+                peers, queue_name="chaos", n_partitions=P, maxsize=256,
+                retain=512, reconnect_tries=6, reconnect_base_s=0.1,
+            )
+            cons = ClusterClient(
+                peers, queue_name="chaos", n_partitions=P, maxsize=256,
+                reconnect_tries=6, reconnect_base_s=0.1,
+            )
+            err = {}
+            kills = {"n": 0, "deleted": False}
+            dead_idx = []
+
+            def restart_victim():
+                candidates = [j for j in range(N) if j not in dead_idx]
+                victim = rng.choice(candidates)
+                servers[victim].shutdown()
+                servers[victim] = boot(victim)  # intact disk: clients
+                kills["n"] += 1                 # ride the reconnect
+
+            def delete_victim():
+                # only a victim that OWNS partitions exercises anything
+                owners = {
+                    prod.partition_map.assignments[p] for p in range(P)
+                }
+                candidates = [
+                    j for j in range(N)
+                    if j not in dead_idx and peers[j] in owners
+                ]
+                victim = rng.choice(candidates)
+                servers[victim].shutdown()
+                shutil.rmtree(dirs[victim])
+                servers[victim] = None  # the machine is gone for good
+                dead_idx.append(victim)
+                kills["n"] += 1
+                kills["deleted"] = True
+                # no wait needed: the server never returns, so BOTH
+                # clients inevitably write it off on their next op
+                # against its partitions (the producer's very next
+                # round-robin put, the consumer's next sweep) — and a
+                # post-run assert pins that they did
+
+            plan = {
+                NF // 5: restart_victim,
+                2 * NF // 5: delete_victim,
+                3 * NF // 5: restart_victim,
+            }
+
+            def produce():
+                try:
+                    for i in range(NF):
+                        assert prod.put_pipelined(
+                            _frame(i), deadline=time.monotonic() + 120
+                        ), i
+                        action = plan.get(i)
+                        if action is not None:
+                            action()
+                    assert prod.flush_puts(time.monotonic() + 120)
+                    assert prod.put_wait(
+                        EndOfStream(0, -1, 1, 1), timeout=120
+                    )
+                except BaseException as e:  # noqa: BLE001 — reported below
+                    err["e"] = e
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            seen, eos = [], 0
+            deadline = time.monotonic() + 300
+            while not eos and time.monotonic() < deadline:
+                if "e" in err:
+                    raise err["e"]
+                for item in cons.get_batch_stream(32, timeout=0.5):
+                    if is_eos(item):
+                        eos += 1
+                    else:
+                        seen.append(item.event_idx)
+            t.join(15)
+            if "e" in err:
+                raise err["e"]
+            assert eos == 1, "end-of-stream never fired"
+            assert kills["n"] >= 3 and kills["deleted"]
+            lost = sorted(set(range(NF)) - set(seen))
+            assert not lost, f"chaos LOST {len(lost)}: {lost[:10]}"
+            # both clients wrote the dead machine off (no split-brain)
+            gone = peers[dead_idx[0]]
+            assert gone not in prod.partition_map.servers
+            assert gone not in cons.partition_map.servers
+            # replay still serves the retained range after the chaos
+            live = [
+                a for i, a in enumerate(peers)
+                if servers[i] is not None
+            ]
+            replayer = ClusterClient(
+                live, queue_name="chaos", n_partitions=P, maxsize=256,
+                reconnect_tries=2, reconnect_base_s=0.1,
+            )
+            try:
+                replayer.replay_open(from_offset="begin", group="audit")
+                replayed = set()
+                empty_reads = 0
+                while empty_reads < 3:
+                    batch = replayer.get_batch(64, timeout=1.0)
+                    if batch:
+                        replayed |= {
+                            b.event_idx for b in batch if not is_eos(b)
+                        }
+                        empty_reads = 0
+                    else:
+                        empty_reads += 1
+                assert len(replayed) >= NF // 2
+            finally:
+                replayer.disconnect()
+        finally:
+            for c in (prod, cons):
+                if c is not None:
+                    try:
+                        c.disconnect()
+                    except Exception:
+                        pass
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:
+                    pass

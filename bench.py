@@ -903,6 +903,18 @@ def main():
         lambda: _bench_replication(extras, smoke),
     )
 
+    # ---------------- serving: SLO-aware gateway under overload ----------
+    # device-free (ISSUE 12): bursty 3-tenant open-loop load at >= 2x
+    # the measured sustainable rate — uncontrolled baseline p99 blows
+    # the SLO; the gateway keeps admitted-work p99 inside it with
+    # goodput >= 80% of B8 capacity and weight-proportional per-tenant
+    # shares, plus the idle row serving at the B1 operating point
+    run_section(
+        wd,
+        "serving",
+        lambda: _bench_serving(extras, smoke),
+    )
+
     # ---------------- config 5: multi-detector fan-in --------------------
     # two independent sections: the kHz HOST demonstration must not lose
     # its number to a tunnel-bound device leg timing out (round-3 run:
@@ -3161,6 +3173,246 @@ def _bench_replication(extras, smoke=False):
                 pass
         shutil.rmtree(scratch, ignore_errors=True)
     extras["replication_kill_delete_disk"] = row
+
+
+def _bench_serving(extras, smoke=False):
+    """SLO-aware serving gateway under overload (ISSUE 12).
+
+    Device model: the dispatch callable SLEEPS the operating-point
+    service time, with the measured B1...B8 frontier scaled 8x so
+    scheduler jitter on this CPU-share-throttled box stays small
+    relative to the service times (the control behavior — what gets
+    admitted, shed, batched — is scale-invariant; the absolute fps are
+    the scaled device's, stated as such). Sustainable capacity is
+    MEASURED first (back-to-back B8 dispatches through the same sleep),
+    not taken from the table.
+
+    Rows (``serving_overload`` / ``serving_idle``):
+
+    - ``uncontrolled`` — bursty 3-tenant open-loop load at ~2x measured
+      capacity into a no-shed FIFO dispatcher: the queue grows without
+      bound and p99 sojourn blows past the SLO (the failure mode the
+      gateway exists for);
+    - ``gateway`` — same offered load through admission control +
+      deadline shedding + WDRR (weights 2:1:1): admitted-work p99 must
+      stay inside the SLO, goodput >= 80% of measured capacity,
+      per-tenant goodput within +-10% of the weight shares, and
+      offered == completed + shed (shed is loud and counted; admitted
+      frames are never lost);
+    - ``serving_idle`` — single tenant far below capacity: every
+      dispatch at the B1 operating point (no batching tax when there is
+      no load), plus the zero-copy pins through the gateway transport
+      path (serve_queue + make_batch_dispatch over a real TCP relay:
+      copies/frame must be exactly 1.00, steady-state pool churn 0).
+    """
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests")
+    )
+    import threading as _threading
+
+    from faultproxy import OpenLoopLoad, arrival_schedule
+
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.serving import (
+        GatewayTelemetry,
+        ServingGateway,
+        SloPolicy,
+        make_batch_dispatch,
+    )
+    from psana_ray_tpu.transport import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+    from psana_ray_tpu.utils.bufpool import BufferPool, WIRE
+
+    SCALE = 8.0  # sleep-device scale over the measured device frontier
+    OPS = tuple((b, ms * SCALE) for b, ms in ((1, 0.89), (2, 1.43), (4, 2.45), (8, 4.33)))
+    SVC = dict(OPS)
+    SLO_MS = 300.0
+    WEIGHTS = {"t0": 2, "t1": 1, "t2": 1}
+    rng = np.random.default_rng(12)
+    frame = FrameRecord(
+        0, 0, rng.integers(0, 4096, size=(2, 8, 8), dtype=np.uint16), 9.5
+    )
+
+    def device(recs, batch_size):
+        time.sleep(SVC[batch_size] / 1000.0)
+
+    # -- measure sustainable capacity on THIS box's sleep granularity -----
+    t0 = time.perf_counter()
+    n_cal = 4 if smoke else 16
+    for _ in range(n_cal):
+        device([frame] * 8, 8)
+    cal_elapsed = time.perf_counter() - t0
+    capacity_fps = (8 * n_cal) / cal_elapsed
+    # seed the policy table with the MEASURED per-batch time (table x
+    # the box's sleep-oversleep factor): measure-then-control starts
+    # from measurement, not the nominal table — the online EWMA keeps
+    # refining from there
+    oversleep = (cal_elapsed / n_cal * 1000.0) / SVC[8]
+    OPS_MEASURED = tuple((b, ms * oversleep) for b, ms in OPS)
+    log(f"serving: measured B8 capacity {capacity_fps:.0f} fps "
+        f"(sleep-device, {SCALE:.0f}x-scaled frontier, oversleep "
+        f"x{oversleep:.3f})")
+
+    # -- overload A/B: 3 tenants, staggered bursts at ~2x capacity --------
+    duration_s = 2.0 if smoke else 6.0
+    rate_per_tenant = 2.0 * capacity_fps / 3.0
+    # period short enough that every tenant's admitted backlog bridges
+    # the inter-burst gap (the smallest share's cap is ~1 B8 batch =
+    # ~123 ms of its share-rate drain vs a ~112 ms gap), so the device
+    # stays fed >= 80% while the arrivals stay violently bursty;
+    # synchronized phases keep the tenants statistically identical (a
+    # staggered start hands the first tenant a solo transient that
+    # skews the measured shares)
+    period_s = 0.15
+
+    def tenant_schedules():
+        return {
+            t: arrival_schedule(
+                "burst", rate_per_tenant, duration_s,
+                burst_factor=4.0, period_s=period_s,
+            )
+            for t in WEIGHTS
+        }
+
+    def run_overload(controlled: bool):
+        policy = SloPolicy(
+            slo_ms=SLO_MS if controlled else 1e9,
+            operating_points=OPS_MEASURED,
+            shed_margin=0.85,
+        )
+        gw = ServingGateway(
+            device, policy=policy, weights=WEIGHTS,
+            telemetry=GatewayTelemetry(register=False),
+        )
+        stop = _threading.Event()
+        loop = _threading.Thread(target=gw.run, args=(stop,), daemon=True)
+        loop.start()
+        t_start = time.perf_counter()
+        offered = OpenLoopLoad(
+            lambda tenant: gw.offer(frame, tenant=tenant), tenant_schedules()
+        ).run(timeout_s=duration_s + 120.0)
+        gw.drain(deadline_s=60.0 if controlled else 10.0)
+        elapsed = time.perf_counter() - t_start
+        stop.set()
+        loop.join(timeout=5.0)
+        s = gw.telemetry.stats()
+        total_offered = sum(offered.values())
+        shares = gw.telemetry.tenant_goodput()
+        total_good = max(1, sum(shares.values()))
+        row = {
+            "mode": "gateway" if controlled else "uncontrolled",
+            "slo_ms": SLO_MS,
+            "offered": total_offered,
+            "admitted": s["admitted_total"],
+            "completed": s["completed_total"],
+            "shed": s["shed_total"],
+            "shed_by_path": gw.telemetry.shed_by_path(),
+            "backlog_left": gw.backlog(),
+            "goodput_fps": round(s["goodput_total"] / elapsed, 1),
+            "capacity_fps": round(capacity_fps, 1),
+            "p99_admitted_ms": max(
+                [s[t]["p99_ms"] for t in WEIGHTS if t in s] or [0.0]
+            ),
+            "slo_attainment": s["slo_attainment"],
+            "tenant_goodput_share": {
+                t: round(shares.get(t, 0) / total_good, 3) for t in WEIGHTS
+            },
+            "conserved": (
+                s["offered_total"]
+                == s["completed_total"] + s["shed_total"] + gw.backlog()
+            ),
+        }
+        return row
+
+    rows = []
+    for controlled in (False, True):
+        row = run_overload(controlled)
+        rows.append(row)
+        log(
+            f"serving [{row['mode']}, 3 tenants {tuple(WEIGHTS.values())}, "
+            f"burst x4 @ {2.0:.1f}x capacity]: p99 {row['p99_admitted_ms']:.0f} ms "
+            f"(SLO {SLO_MS:.0f}), goodput {row['goodput_fps']:.0f}/"
+            f"{row['capacity_fps']:.0f} fps, shed {row['shed']}/"
+            f"{row['offered']}, shares {row['tenant_goodput_share']}"
+        )
+    extras["serving_overload"] = rows
+    base, gwy = rows
+    checks = {
+        "baseline_blows_slo": base["p99_admitted_ms"] > SLO_MS,
+        "gateway_p99_in_slo": gwy["p99_admitted_ms"] <= SLO_MS,
+        "goodput_ge_80pct_capacity": (
+            gwy["goodput_fps"] >= 0.8 * capacity_fps
+        ),
+        "tenant_shares_within_10pct": all(
+            abs(gwy["tenant_goodput_share"][t] - w / sum(WEIGHTS.values()))
+            <= 0.1 * (w / sum(WEIGHTS.values()))
+            for t, w in WEIGHTS.items()
+        ),
+        "conserved": base["conserved"] and gwy["conserved"],
+    }
+    extras["serving_overload_acceptance"] = checks
+    log(f"serving acceptance: {checks}")
+
+    # -- idle row: B1 latency + the zero-copy pins through the gateway ----
+    n_idle = 8 if smoke else 24
+    pool = BufferPool()
+    q = RingBuffer(64)
+    srv = TcpQueueServer(q, host="127.0.0.1", pool=pool).serve_background()
+    prod = TcpQueueClient("127.0.0.1", srv.port, pool=pool)
+    cons = TcpQueueClient(
+        "127.0.0.1", srv.port, pool=pool, tenant="idle", tenant_weight=1
+    )
+    batch_sizes = []
+
+    def consume(batch):
+        batch_sizes.append(batch.batch_size)
+
+    gw = ServingGateway(
+        make_batch_dispatch(consume),
+        policy=SloPolicy(slo_ms=SLO_MS, operating_points=OPS),
+        telemetry=GatewayTelemetry(register=False),
+    )
+    try:
+        idle_gap_s = SVC[8] / 1000.0 * 2  # arrivals far apart: no backlog
+
+        def produce():
+            for i in range(n_idle):
+                assert prod.put_wait(
+                    FrameRecord(0, i, frame.panels, 9.5), timeout=30
+                )
+                time.sleep(idle_gap_s)
+            assert prod.put_wait(EndOfStream(total_events=n_idle), timeout=30)
+
+        t = _threading.Thread(target=produce, daemon=True)
+        c0 = WIRE.stats()
+        t.start()
+        gw.serve_queue(cons, max_wait_s=60.0)
+        t.join(timeout=30)
+        d = WIRE.stats()
+        copies = (d["copies_total"] - c0["copies_total"]) / max(1, n_idle)
+        s = gw.telemetry.stats()
+        lat = s.get("default", {}).get("p99_ms", 0.0)
+        idle_row = {
+            "frames": n_idle,
+            "completed": s["completed_total"],
+            "b1_dispatches": sum(1 for b in batch_sizes if b == 1),
+            "dispatches": len(batch_sizes),
+            "p99_ms": lat,
+            "copies_per_frame": round(copies, 2),
+            "pool_churn_misses": pool.stats()["churn_misses"],
+            "at_b1_operating_point": all(b == 1 for b in batch_sizes),
+        }
+        extras["serving_idle"] = idle_row
+        log(
+            f"serving [idle single-tenant]: {idle_row['b1_dispatches']}/"
+            f"{idle_row['dispatches']} dispatches at B1, p99 "
+            f"{lat:.1f} ms, copies/frame {idle_row['copies_per_frame']:.2f}, "
+            f"pool churn {idle_row['pool_churn_misses']}"
+        )
+    finally:
+        prod.disconnect()
+        cons.disconnect()
+        srv.shutdown()
 
 
 def _bench_connection_scaling(extras, smoke=False):

@@ -124,6 +124,8 @@ class ClusterClient:
         heartbeat_s: float = 1.0,
         pool=None,
         codec=None,
+        tenant=None,
+        tenant_weight: int = 1,
     ):
         self._addresses = parse_cluster_address(
             servers if isinstance(servers, str) else ",".join(servers)
@@ -141,8 +143,12 @@ class ClusterClient:
         self._pool = pool
         # wire compression (ISSUE 9): negotiated PER PARTITION CONNECTION
         # — each TcpQueueClient advertises this and its server picks, so
-        # a mixed-version cluster degrades per server, not per stream
+        # a mixed-version cluster degrades per server, not per stream.
+        # The tenant hello (ISSUE 12) rides the same exchange, so every
+        # partition connection carries the stream's fair-share identity
         self._codec = codec
+        self._tenant = tenant
+        self._tenant_weight = tenant_weight
         self._lock = threading.RLock()
         self._map = PartitionMap.compute(
             self._addresses, queue_name, n_partitions
@@ -466,6 +472,8 @@ class ClusterClient:
                     pool=self._pool,
                     put_window=self._put_window,
                     codec=self._codec,
+                    tenant=self._tenant,
+                    tenant_weight=self._tenant_weight,
                 )
                 rng = None
                 try:
@@ -501,6 +509,8 @@ class ClusterClient:
                     pool=self._pool,
                     put_window=self._put_window,
                     codec=self._codec,
+                    tenant=self._tenant,
+                    tenant_weight=self._tenant_weight,
                 )
             self._clients[p] = c
         return c  # deferred resend flushes in _with_failover, once per op

@@ -113,6 +113,10 @@ class ReplicationTelemetry:
         self.degrades = 0  # guarded-by: _lock
         self.restores = 0  # guarded-by: _lock
         self.fenced_links = 0  # guarded-by: _lock
+        # owners that restarted BEHIND their replica and fenced
+        # themselves instead of rewinding the better copy (the README
+        # D2 "repairing a fenced owner" runbook's trigger gauge)
+        self.owners_fenced_behind = 0  # guarded-by: _lock
         self.replica_appends = 0  # follower-side records logged  # guarded-by: _lock
         self.promotes = 0  # follower-side promotions served  # guarded-by: _lock
         self.coord_syncs = 0  # guarded-by: _lock
@@ -168,6 +172,10 @@ class ReplicationTelemetry:
         with self._lock:
             self.fenced_links += 1
 
+    def owner_fenced_behind(self):
+        with self._lock:
+            self.owners_fenced_behind += 1
+
     def replica_appended(self):
         self.ensure_registered()
         with self._lock:
@@ -200,6 +208,7 @@ class ReplicationTelemetry:
                 "degrades": self.degrades,
                 "restores": self.restores,
                 "fenced_links": self.fenced_links,
+                "owners_fenced_behind": self.owners_fenced_behind,
                 "replica_appends": self.replica_appends,
                 "promotes": self.promotes,
                 "coord_syncs": self.coord_syncs,
@@ -518,8 +527,16 @@ class ReplicationSender:
             # would REWIND the replica over acknowledged records —
             # destroying the only surviving copy. Refuse, loudly:
             # fence ourselves and degrade (operators restart clients so
-            # the follower promotes, or restore this disk).
+            # the follower promotes, or restore this disk). The
+            # dedicated breadcrumb + owners_fenced_behind gauge are what
+            # the README D2 "repairing a fenced owner" runbook keys on.
             link.hang_up()
+            REPL.owner_fenced_behind()
+            FLIGHT.record(
+                "owner_fenced_behind_replica", queue=self.queue_name,
+                follower=self.follower, follower_tail=tail,
+                local_tail=self.log.next_offset,
+            )
             raise ReplicaRefused(
                 f"follower {self.follower} holds {tail} records of "
                 f"{self.queue_name} but the local log ends at "

@@ -124,6 +124,14 @@ class TransportConfig:
     # compression even starts (records.narrow_panels — integer targets
     # round + clip to the representable range)
     wire_dtype: str = ""
+    # serving fair-share (ISSUE 12, tcp:// and cluster:// transports):
+    # the tenant identity + weight this endpoint's connections announce
+    # on the 'Z' capability exchange. The event loop's stream pump is
+    # weighted deficit round-robin over tenants, so one greedy tenant
+    # cannot starve the rest. "" = the shared default tenant (weight 1,
+    # pre-ISSUE-12 behavior). Weight range 1-64.
+    tenant: str = ""
+    tenant_weight: int = 1
 
 
 @dataclasses.dataclass

@@ -295,12 +295,17 @@ def main(argv=None):
         "summary; 0 = off",
     )
     from psana_ray_tpu.obs import add_metrics_args, add_trace_args
-    from psana_ray_tpu.transport.addressing import add_cluster_args, add_wire_args
+    from psana_ray_tpu.transport.addressing import (
+        add_cluster_args,
+        add_tenant_args,
+        add_wire_args,
+    )
 
     add_metrics_args(p)
     add_trace_args(p)
     add_cluster_args(p, consumer=True)
     add_wire_args(p)
+    add_tenant_args(p)
     p.add_argument(
         "--cursor_path", default=None,
         help="persist a StreamCursor (contiguous per-shard watermark of "
@@ -328,13 +333,20 @@ def main(argv=None):
         format="%(asctime)s - %(levelname)s - %(message)s",
     )
     log = logging.getLogger("consumer")
-    from psana_ray_tpu.transport.addressing import apply_cluster_args, apply_wire_args
+    from psana_ray_tpu.transport.addressing import (
+        apply_cluster_args,
+        apply_tenant_args,
+        apply_wire_args,
+    )
 
     # --cluster rewrites the address (and carries partitions/group); the
     # DataReader below sees the sharded service as just another address.
-    # --wire_codec rides the same config into open_queue
-    reader_config = apply_wire_args(
-        apply_cluster_args(TransportConfig(address=a.address), a), a
+    # --wire_codec and --tenant ride the same config into open_queue
+    reader_config = apply_tenant_args(
+        apply_wire_args(
+            apply_cluster_args(TransportConfig(address=a.address), a), a
+        ),
+        a,
     )
     a.address = reader_config.address
 

@@ -51,6 +51,13 @@ ROOTS = {
     "FanInPipeline._put",
     "FanInPipeline.__iter__",
     "FanInPipeline.close",
+    # the serving gateway's dispatch loop (ISSUE 12): admission,
+    # WDRR dispatch, and the transport pump sit directly on the
+    # latency SLO — a sleep here IS a missed deadline
+    "ServingGateway.offer",
+    "ServingGateway.dispatch_once",
+    "ServingGateway.run",
+    "ServingGateway.serve_queue",
 }
 
 # bare-name edges the getattr() transport-preference indirection hides.
@@ -60,8 +67,12 @@ ROOTS = {
 # -> _merge_drain -> _pop/_sift, ISSUE 7), which is exactly the audited
 # surface we want: a sleep pacing the partition sweep stalls the whole
 # infeed. Pinned by test_lint's cluster_merge_drain fixture pair.
+# ServingGateway.serve_queue uses the same getattr drain-preference
+# idiom as batches_from_queue, so it carries the same seeds (pinned by
+# the gateway_dispatch fixture pair).
 SEED_EDGES = {
-    "batches_from_queue": ("get_batch", "get_batch_view", "get_batch_stream")
+    "batches_from_queue": ("get_batch", "get_batch_view", "get_batch_stream"),
+    "serve_queue": ("get_batch", "get_batch_view", "get_batch_stream"),
 }
 
 EXCLUDE_PREFIXES = ("TcpQueueClient.",)

@@ -20,6 +20,14 @@ condition clears), handed to ``on_event``, kept in a bounded ``events``
 deque, and counted — the detector is itself a registry source, so
 ``psana_ray_stalls_*_total`` series appear on the metrics endpoint.
 
+Since ISSUE 12 the detector also ACTS, not just warns: while any
+episode is active a ``degraded`` gauge is up, and a serving gateway
+bound via :meth:`StallDetector.bind_gateway` is ESCALATED (its shed
+threshold rises — admission runs against the shrunken degraded budget)
+for the duration; when the last episode clears, ``on_clear`` fires and
+the gateway is restored. The escalate/restore cycle is pinned by
+tests/test_serving.py.
+
 ``poll_once(now=...)`` is separated from the thread loop so tests drive
 time explicitly instead of sleeping.
 """
@@ -88,12 +96,16 @@ class StallDetector:
         full_threshold_s: float = 5.0,
         idle_threshold_s: float = 10.0,
         on_event: Optional[Callable[[StallEvent], None]] = None,
+        on_clear: Optional[Callable[[], None]] = None,
         max_events: int = 256,
     ):
         self.poll_interval_s = poll_interval_s
         self.full_threshold_s = full_threshold_s
         self.idle_threshold_s = idle_threshold_s
         self.on_event = on_event
+        # fired once when the LAST active episode clears (the moment the
+        # degraded gauge drops) — the restore half of escalate/restore
+        self.on_clear = on_clear
         self.events: deque = deque(maxlen=max_events)
         self._counts: Dict[str, int] = {
             EVENT_BACKPRESSURE: 0,
@@ -104,6 +116,8 @@ class StallDetector:
         self._watched: Dict[str, Any] = {}
         self._provider: Optional[Callable[[], Dict[str, Any]]] = None
         self._states: Dict[str, _QueueState] = {}
+        self._degraded = False  # any episode active  # guarded-by: _lock
+        self._gateways: list = []  # escalate/restore targets  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -119,6 +133,19 @@ class StallDetector:
         ``{name: queue}`` each poll (the queue server's named queues
         appear as clients OPEN them)."""
         self._provider = provider
+        return self
+
+    def bind_gateway(self, gateway) -> "StallDetector":
+        """Escalate a :class:`~psana_ray_tpu.serving.gateway.
+        ServingGateway` while any stall episode is active: its shed
+        threshold rises on the first firing and restores when the last
+        episode clears — the detector shouting into action instead of
+        the void (ISSUE 12)."""
+        with self._lock:
+            self._gateways.append(gateway)
+            degraded = self._degraded
+        if degraded:  # bound mid-episode: catch up immediately
+            gateway.escalate("stall-detector (bound mid-episode)")
         return self
 
     def start(self) -> "StallDetector":
@@ -159,12 +186,57 @@ class StallDetector:
 
     def poll_once(self, now: Optional[float] = None):
         now = time.monotonic() if now is None else now
+        seen = set()
         for name, queue in self._queues().items():
+            seen.add(name)
             try:
                 stats = _queue_stats(queue)
-            except Exception:  # dead transport: closure is its own signal
+            except Exception:
+                # dead transport: closure is its own signal — and the
+                # episode can never be observed clearing, so DROP the
+                # state (a dead queue must not latch the degraded gauge
+                # and hold bound gateways escalated forever)
+                with self._lock:
+                    self._states.pop(name, None)
                 continue
             self._check_queue(name, stats, now)
+        with self._lock:  # queues that left the watch population too
+            for name in [n for n in self._states if n not in seen]:
+                self._states.pop(name)
+        self._check_cleared()
+
+    @property
+    def degraded(self) -> bool:
+        """True while any stall episode is active (the gauge the bound
+        gateways' shed thresholds follow)."""
+        with self._lock:
+            return self._degraded
+
+    def _check_cleared(self):
+        """Drop the degraded gauge (and restore bound gateways) once no
+        watched queue has an active episode left."""
+        with self._lock:
+            if not self._degraded:
+                return
+            active = any(
+                st.full_warned or st.idle_warned or st.starved_warned
+                for st in self._states.values()
+            )
+            if active:
+                return
+            self._degraded = False
+            gateways = list(self._gateways)
+        logger.info("STALL cleared: all episodes resolved")
+        for gw in gateways:
+            try:
+                gw.restore()
+            except Exception:  # noqa: BLE001 — the watchdog outlives faults
+                logger.exception("stall gateway restore failed")
+        if self.on_clear is not None:
+            try:
+                self.on_clear()
+            except Exception:  # noqa: BLE001
+                logger.exception("stall on_clear callback failed")
 
     def _check_queue(self, name: str, stats: dict, now: float):
         with self._lock:  # scrapes iterate _states from the HTTP thread
@@ -227,8 +299,15 @@ class StallDetector:
     def _emit(self, event: StallEvent):
         with self._lock:
             self._counts[event.kind] = self._counts.get(event.kind, 0) + 1
+            self._degraded = True
+            gateways = list(self._gateways)
         self.events.append(event)
         logger.warning("STALL %s", event.to_json())
+        for gw in gateways:  # firing acts, not just warns (ISSUE 12)
+            try:
+                gw.escalate(f"{event.kind}:{event.queue}")
+            except Exception:  # noqa: BLE001 — the watchdog outlives faults
+                logger.exception("stall gateway escalate failed")
         if self.on_event is not None:
             try:
                 self.on_event(event)
@@ -240,7 +319,9 @@ class StallDetector:
         with self._lock:
             counts = dict(self._counts)
             states = list(self._states.items())
+            degraded = self._degraded
         out: dict = {f"{k}_total": v for k, v in counts.items()}
+        out["degraded"] = 1 if degraded else 0
         for name, st in states:
             out[name] = {
                 "put_rate": round(st.put_rate, 3),

@@ -117,6 +117,42 @@ def add_wire_args(parser, producer: bool = False) -> None:
         )
 
 
+def add_tenant_args(parser) -> None:
+    """The serving fair-share CLI surface (ISSUE 12)."""
+    parser.add_argument(
+        "--tenant", default="", metavar="NAME",
+        help="fair-share tenant identity for this endpoint's queue "
+        "connections (tcp:// and cluster:// transports): the event "
+        "loop's stream pump serves tenants by weighted deficit "
+        "round-robin, so one greedy tenant cannot starve the rest. "
+        "Rides the existing capability exchange — zero new wire "
+        "surface; old servers ignore it. Default: the shared default "
+        "tenant",
+    )
+    parser.add_argument(
+        "--tenant_weight", type=int, default=1, metavar="1-64",
+        help="this tenant's fair-share weight (goodput under "
+        "contention converges to the weight shares)",
+    )
+
+
+def apply_tenant_args(config: TransportConfig, args) -> TransportConfig:
+    """Fold the tenant flags into a TransportConfig."""
+    import dataclasses
+
+    tenant = getattr(args, "tenant", "") or ""
+    weight = int(getattr(args, "tenant_weight", 1) or 1)
+    if not 1 <= weight <= 64:
+        raise ValueError(f"--tenant_weight must be in [1, 64], got {weight}")
+    if not tenant:
+        if weight != 1:
+            # refusing loudly beats silently serving at default weight:
+            # the weight only means something under a tenant identity
+            raise ValueError("--tenant_weight requires --tenant")
+        return config
+    return dataclasses.replace(config, tenant=tenant, tenant_weight=weight)
+
+
 def apply_wire_args(config: TransportConfig, args) -> TransportConfig:
     """Fold the wire-compression flags into a TransportConfig."""
     import dataclasses
@@ -151,8 +187,10 @@ def open_queue(
         raise ValueError(f"role must be producer|consumer, got {role!r}")
     address = address or config.address
     # one normalization of the codec knob for every TCP-family branch:
-    # ""/"none" -> no negotiation
+    # ""/"none" -> no negotiation; likewise the tenant hello ("" = the
+    # shared default tenant, no capability field on the wire)
     wire_codec = config.wire_codec if config.wire_codec not in ("", "none") else None
+    tenant = config.tenant or None
 
     if address in ("auto", "local"):
         reg = registry or Registry.default()
@@ -214,6 +252,8 @@ def open_queue(
             group=group or None,
             member_id=config.member_id or None,
             codec=wire_codec,
+            tenant=tenant,
+            tenant_weight=config.tenant_weight,
         )
 
     if address.startswith("tcp://"):
@@ -232,6 +272,8 @@ def open_queue(
             queue_name=config.queue_name,
             maxsize=config.queue_size,
             codec=wire_codec,
+            tenant=tenant,
+            tenant_weight=config.tenant_weight,
         )
 
     raise ValueError(

@@ -64,7 +64,7 @@ import struct
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from psana_ray_tpu.obs.flight import FLIGHT
 from psana_ray_tpu.obs.tracing import TRACER
@@ -132,6 +132,78 @@ PROBE_INTERVAL_S = 0.5
 # max frames popped per stream-waiter visit — fairness bound so one
 # wide-window subscriber cannot monopolize a pump pass
 _STREAM_POP_MAX = 64
+
+# weighted deficit round-robin (ISSUE 12): frames of deficit each
+# tenant earns per replenish round, per unit of weight. Small enough
+# that weight shares converge within a few hundred frames; large
+# enough that a weight-1 tenant still fills a whole max-size batch
+_WDRR_QUANTUM = 8
+_TENANT_DEFAULT = "default"
+_TENANT_WEIGHT_MAX = 64
+
+
+class _Wdrr:
+    """Per-queue weighted-deficit state for the stream pump: streams
+    sharing a queue are served in arrival rotation, but each pop is
+    capped by the connection's TENANT deficit. A replenish round hands
+    out ``_WDRR_QUANTUM`` frames PER WAITING STREAM CONNECTION, split
+    across tenants in proportion to weight — so a tenant's share is
+    weight-proportional no matter how many sockets or credits it
+    brings (one greedy tenant cannot starve the rest), while the
+    round's total volume scales with the fleet (1024 single-tenant
+    subscribers keep the pre-ISSUE-12 per-pass throughput: their one
+    shared budget is 1024 x quantum, not 1 x). Loop-thread-only state:
+    no lock."""
+
+    __slots__ = ("deficit",)
+
+    def __init__(self):
+        self.deficit: Dict[str, float] = {}
+
+    def allowance(self, tenant: str) -> float:
+        return self.deficit.get(tenant, 0.0)
+
+    def charge(self, tenant: str, n: int) -> None:
+        self.deficit[tenant] = self.deficit.get(tenant, 0.0) - n
+
+    def all_dry(self, tenant_weights: Dict[str, int]) -> bool:
+        """No waiting tenant can pop even one frame — time for a round."""
+        return all(self.deficit.get(t, 0.0) < 1.0 for t in tenant_weights)
+
+    def replenish(self, tenant_weights: Dict[str, int], n_conns: int) -> None:
+        """A new round: ``quantum * n_conns`` total frames of deficit,
+        split by weight share, capped at two rounds of credit (bursts
+        must not bank unbounded catch-up); tenants that left are
+        dropped."""
+        if not tenant_weights:
+            return
+        for t in list(self.deficit):
+            if t not in tenant_weights:
+                del self.deficit[t]
+        total = float(_WDRR_QUANTUM * max(1, n_conns))
+        sum_w = sum(tenant_weights.values())
+        for t, w in tenant_weights.items():
+            earn = max(1.0, total * w / sum_w)
+            self.deficit[t] = min(
+                2.0 * earn, max(0.0, self.deficit.get(t, 0.0)) + earn
+            )
+
+
+def _stream_tenant_weights(get_waiters) -> Tuple[Dict[str, int], int]:
+    """(tenant -> weight, live stream-conn count) over one queue's
+    waiters (several connections may share a tenant; the LARGEST
+    advertised weight wins — a tenant's share is per tenant, not per
+    socket)."""
+    out: Dict[str, int] = {}
+    n = 0
+    for conn in get_waiters:
+        if conn.stream is None or conn.closed:
+            continue
+        n += 1
+        w = out.get(conn.tenant, 0)
+        if conn.weight > w:
+            out[conn.tenant] = conn.weight
+    return out, n
 
 
 class EvLoopTelemetry:
@@ -239,7 +311,7 @@ class _QueueState:
 
     __slots__ = (
         "queue", "get_waiters", "put_waiters", "ra_waiters", "repl",
-        "listened", "unlisten",
+        "listened", "unlisten", "wdrr",
     )
 
     def __init__(self, queue):
@@ -253,6 +325,8 @@ class _QueueState:
         self.repl = None  # the queue's ReplicationSender, cached
         self.listened = False
         self.unlisten = None  # callable removing the change listener
+        # per-tenant weighted-deficit budgets for the stream pump
+        self.wdrr = _Wdrr()
 
 
 class _QueueClosedSignal(Exception):
@@ -267,7 +341,8 @@ class _EvConn:
     __slots__ = (
         "loop", "sock", "srv", "queue", "in_flight", "out", "out_bytes",
         "closing", "closed", "stream", "replay", "replica", "pending",
-        "op_gen", "codec", "_out_enq_total", "_out_releases",
+        "op_gen", "codec", "tenant", "weight",
+        "_out_enq_total", "_out_releases",
         "_hdr", "_hdr_mv", "_target", "_need", "_got", "_cb", "_lease",
         "_want_read", "_want_write", "_mask", "_sendmsg",
         "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq",
@@ -294,6 +369,11 @@ class _EvConn:
         # record's cached compressed bytes when the codec matches);
         # receives are tag-driven and need no per-connection state
         self.codec = None
+        # fair-share identity (ISSUE 12): set by the tenant=<name>:<w>
+        # capability field on the 'Z' exchange; connections that never
+        # hello share the default tenant's budget (pre-ISSUE-12 parity)
+        self.tenant = _TENANT_DEFAULT
+        self.weight = 1
         # compressed staging leases awaiting flush: (enqueued-bytes
         # mark, lease) released once the outbound byte counter passes
         # the mark — a lease must outlive its queued memoryview
@@ -1051,7 +1131,33 @@ class _EvConn:
         self._arm(memoryview(self._open_buf), self._codec_finish)
 
     def _codec_finish(self) -> None:
-        names = self._open_buf.decode().split(",")
+        # the 'Z' advert mixes codec NAMES with capability FIELDS
+        # (key=value, ISSUE 12); fields are peeled off here and the
+        # codec picker sees only names — a field it predates is simply
+        # an unknown name to an older picker, which skips it (that is
+        # what makes the hello rideable on the existing exchange)
+        names = []
+        for entry in self._open_buf.decode().split(","):
+            entry = entry.strip()
+            key, sep, value = entry.partition("=")
+            if not sep:
+                names.append(entry)
+                continue
+            if key == "tenant":
+                tenant, _, w = value.partition(":")
+                self.tenant = tenant or _TENANT_DEFAULT
+                try:
+                    self.weight = max(
+                        1, min(_TENANT_WEIGHT_MAX, int(w))
+                    ) if w else 1
+                except ValueError:
+                    self.weight = 1
+                FLIGHT.record(
+                    "tenant_hello", port=self.srv.port,
+                    tenant=self.tenant, weight=self.weight,
+                )
+            # unknown capability keys are ignored: a newer client must
+            # degrade gracefully against this server, not die
         chosen = negotiate_codec(names)
         self.codec = chosen
         name = chosen.name if chosen is not None else CODEC_NONE
@@ -1543,7 +1649,19 @@ class EventLoop:
                     return False
             except TransportClosed:
                 raise _QueueClosedSignal from None
+        # WDRR round bookkeeping (ISSUE 12): when EVERY waiting stream
+        # tenant's deficit is dry, start a new round up front (the
+        # common single-tenant case replenishes once and serves a full
+        # pass, pre-ISSUE-12 throughput)
+        weights, n_stream = _stream_tenant_weights(gw)
+        if weights and qs.wdrr.all_dry(weights):
+            qs.wdrr.replenish(weights, n_stream)
         visits = len(gw)
+        # streams skipped ONLY because their tenant's WDRR deficit ran
+        # dry this round (credit-blocked or empty-queue skips don't
+        # count): when that is the only reason nothing moved, a new
+        # round replenishes every waiting tenant and the pump re-runs
+        blocked_on_allowance = False
         while visits and gw:
             visits -= 1
             conn = gw[0]
@@ -1571,7 +1689,23 @@ class EventLoop:
                 did = True
                 continue
             if conn.stream is not None:
-                want = min(conn.stream.budget(), _STREAM_POP_MAX)
+                allow = qs.wdrr.allowance(conn.tenant)
+                if allow < 1.0:
+                    # tenant budget exhausted this WDRR round: other
+                    # tenants' streams go first (weighted fair-share)
+                    blocked_on_allowance = True
+                    gw.rotate(-1)
+                    continue
+                # per-VISIT cap at quantum * weight: within a shared
+                # tenant budget, rotation (serve-rotate + blocked-rotate
+                # is a full cycle with two conns) would otherwise hand
+                # the whole round to whichever conn sits first — each
+                # visit takes one quantum so same-tenant conns split
+                # their tenant's round evenly
+                want = min(
+                    conn.stream.budget(), _STREAM_POP_MAX, int(allow),
+                    _WDRR_QUANTUM * conn.weight,
+                )
                 if want <= 0:
                     gw.rotate(-1)  # window full: wait for credits
                     continue
@@ -1602,6 +1736,7 @@ class EventLoop:
                 break  # queue empty: every remaining get-waiter waits
             try:
                 if conn.stream is not None:
+                    qs.wdrr.charge(conn.tenant, len(items))
                     conn.push_stream_items(items)
                     gw.rotate(-1)  # round-robin fairness across streams
                 else:
@@ -1612,6 +1747,18 @@ class EventLoop:
                 # the waiter died with items popped: standard redelivery
                 self.kill_conn(conn, e)
             did = True
+        if not did and blocked_on_allowance:
+            # frames exist but every stream that could still serve was
+            # allowance-blocked (a credit-stalled tenant may be sitting
+            # on unspent deficit, which all_dry above would wait on
+            # forever): force a new round. Reporting progress makes
+            # _pump_all re-run this pump with fresh budgets — the next
+            # pass either serves frames or finds nothing but
+            # credit/emptiness blocks (allowances now >= 1, so the
+            # blocked flag stays down and the loop ends)
+            weights, n_stream = _stream_tenant_weights(gw)
+            qs.wdrr.replenish(weights, n_stream)
+            did = bool(weights)
         return did
 
     def _pump_put(self, qs: _QueueState) -> bool:

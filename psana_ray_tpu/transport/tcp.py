@@ -60,16 +60,24 @@ Wire protocol (all little-endian):
                   offset (offset u64 max = "everything delivered to this
                   connection's replay cursor so far"); '0' when the
                   bound queue has no log
-              'Z' (codec negotiate) + len:u16 + comma-separated codec
-                  names — wire-compression capability exchange (ISSUE
-                  9): the client advertises the codecs it can decode,
-                  in preference order; the server picks the first one
+              'Z' (capability exchange) + len:u16 + comma-separated
+                  entries — wire-compression negotiation (ISSUE 9) plus
+                  per-connection capability FIELDS (ISSUE 12): plain
+                  entries are codec names the client can decode, in
+                  preference order; entries of the form ``key=value``
+                  are capability fields (currently
+                  ``tenant=<name>[:<weight>]`` — the tenant identity +
+                  fair-share weight the event loop's weighted
+                  deficit-round-robin stream pump serves this
+                  connection under). The server picks the first codec
                   it also implements (or "none") and BOTH sides apply
                   it to frame payloads on THIS connection from the
                   next message on (payload tag 'C', transport/codec.py;
                   a frame that expands under the codec still ships raw
                   — compression is an encoding, never a requirement).
-                  Clients that never negotiate see byte-identical wire
+                  Servers predating a capability field ignore it (the
+                  codec picker skips entries it does not recognize);
+                  clients that never negotiate see byte-identical wire
                   traffic to pre-codec peers
               'H' (replica-subscribe) + ns_len:u16 + ns + name_len:u16
                   + name — replication (ISSUE 11): switch this
@@ -872,6 +880,8 @@ class TcpQueueClient:
         pool: Optional[BufferPool] = None,
         put_window: int = DEFAULT_STREAM_WINDOW,
         codec: Optional[str] = None,
+        tenant: Optional[str] = None,
+        tenant_weight: int = 1,
     ):
         """``codec`` opts this connection into wire compression (ISSUE
         9): ``"auto"`` advertises every codec this build implements,
@@ -880,7 +890,15 @@ class TcpQueueClient:
         byte-identical to pre-codec clients. The SERVER picks the
         codec (opcode 'Z'); an old server that answers the opcode with
         a protocol error degrades this client to uncompressed, loudly
-        (flight breadcrumb), not fatally."""
+        (flight breadcrumb), not fatally.
+
+        ``tenant`` (ISSUE 12) names this connection's fair-share tenant
+        and ``tenant_weight`` (1-64) its weight; both ride the same 'Z'
+        capability exchange as ``key=value`` entries, so a tenant hello
+        costs zero new opcodes and an old server that refuses 'Z'
+        degrades the hello away with the codec (the connection then
+        serves under the default tenant, loudly breadcrumbed, never
+        fatally)."""
         self.host, self.port = host, port
         self._timeout_s = timeout_s
         # pooled receive staging: GET/B payloads land via recv_into in
@@ -921,6 +939,24 @@ class TcpQueueClient:
                 self._codec_names = names
         self._codec = None  # guarded-by: _lock
         self._codec_refused = False  # guarded-by: _lock
+        # tenant hello (ISSUE 12): capability fields appended to the 'Z'
+        # advert. Validated here so a malformed name fails fast instead
+        # of desyncing the comma-separated wire list.
+        self._hello_fields: List[str] = []
+        if tenant is not None:
+            if not tenant or any(c in tenant for c in ",=:\n"):
+                raise ValueError(
+                    f"tenant name {tenant!r} may not be empty or contain "
+                    f"',' '=' ':' or newlines (it rides a comma-separated "
+                    f"capability list)"
+                )
+            w = int(tenant_weight)
+            if not 1 <= w <= 64:
+                raise ValueError(
+                    f"tenant_weight must be in [1, 64], got {tenant_weight}"
+                )
+            self._hello_fields.append(f"tenant={tenant}:{w}")
+        self.tenant = tenant
         # the INITIAL dial goes through the same backoff machinery as
         # mid-stream drops: a consumer starting while the server is mid-
         # restart under a supervisor must wait it out, not crash with a
@@ -933,7 +969,7 @@ class TcpQueueClient:
             self._reconnect(e)  # raises TransportClosed when exhausted
         if namespace is not None or queue_name is not None:
             self.open(namespace or "default", queue_name or "default", maxsize)
-        if self._codec_names:
+        if self._codec_names or self._hello_fields:
             self._negotiate()
 
     def open(self, namespace: str, queue_name: str, maxsize: int = 0):
@@ -980,7 +1016,11 @@ class TcpQueueClient:
         # guarded-by-caller: _lock
         if self._codec_refused:
             return
-        names = ",".join(self._codec_names).encode()
+        # codec names first (the server picks the first it knows), then
+        # the capability fields; with no codecs the explicit "none"
+        # keeps the server's pick unambiguous
+        advert = [*(self._codec_names or [CODEC_NONE]), *self._hello_fields]
+        names = ",".join(advert).encode()
         self._sock.sendall(_OP_CODEC + struct.pack("<H", len(names)) + names)
         try:
             self._status()
@@ -1074,11 +1114,13 @@ class TcpQueueClient:
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._binding is not None:
                     self._open_raw(*self._binding)
-                if self._codec_names:
+                if self._codec_names or self._hello_fields:
                     # renegotiate BEFORE any payload-bearing replay: the
                     # windowed resend below must know whether this
                     # connection compresses (an old-peer refusal latches
-                    # and the resend simply goes out raw)
+                    # and the resend simply goes out raw), and the
+                    # tenant hello must re-bind the fresh connection's
+                    # fair-share identity before it carries traffic
                     self._negotiate_raw()
                 if self._replay_args is not None:
                     # re-open the replay cursor at the group's committed

@@ -26,6 +26,17 @@ local port and forward to a destination.
     Context manager; the hook is process-wide, so use it around
     in-process servers only.
 
+:func:`arrival_schedule` / :class:`OpenLoopLoad`
+    Open-loop burst generation (ISSUE 12): DETERMINISTIC arrival-time
+    schedules (steady / burst / ramp profiles) plus a driver that fires
+    per-tenant ``submit`` callbacks at those times regardless of how
+    the system under test is coping — an open-loop source keeps
+    offering at the configured rate while the server drowns, which is
+    exactly the adversary an admission-controlled gateway exists for
+    (a closed-loop client would politely back off and hide the
+    overload). Reused by the bench ``serving`` section and the gateway
+    tests.
+
 :class:`FaultProxy`
     Byte-counting fault injector. Faults are armed per direction
     (``"up"`` = client->server, ``"down"`` = server->client):
@@ -314,6 +325,117 @@ class ThrottleProxy:
                 s.close()
             except OSError:
                 pass
+
+
+def arrival_schedule(
+    profile: str,
+    rate_hz: float,
+    duration_s: float,
+    burst_factor: float = 4.0,
+    period_s: float = 1.0,
+    ramp_to_hz: float = 0.0,
+):
+    """Deterministic open-loop arrival offsets (seconds from start),
+    sorted ascending. ``rate_hz`` is the MEAN rate for every profile,
+    so A/B rows at different shapes offer the same total work:
+
+    - ``steady``: uniform spacing at ``rate_hz``;
+    - ``burst``: square wave with period ``period_s`` — all of each
+      period's arrivals land inside its first ``1/burst_factor``
+      fraction (instantaneous rate ``burst_factor * rate_hz``, then
+      silence): the queue-dwell adversary;
+    - ``ramp``: rate climbs linearly to ``ramp_to_hz`` (default
+      ``2 * rate_hz``), starting low enough that the MEAN stays
+      ``rate_hz``: the knee-finding shape.
+    """
+    if rate_hz <= 0 or duration_s <= 0:
+        return []
+    n = int(rate_hz * duration_s)
+    if profile == "steady":
+        return [i / rate_hz for i in range(n)]
+    if profile == "burst":
+        if burst_factor <= 1.0:
+            raise ValueError(f"burst_factor must exceed 1, got {burst_factor}")
+        if period_s <= 0:
+            raise ValueError(f"period_s must be positive, got {period_s}")
+        # fractional per-period arithmetic: int() truncation here would
+        # realize a different mean rate than documented (and collapse
+        # to one arrival/period when rate_hz * period_s < 2)
+        per_period = rate_hz * period_s
+        on_s = period_s / burst_factor
+        out = []
+        for i in range(n):
+            period_idx = int(i // per_period)
+            k = i - period_idx * per_period
+            out.append(period_idx * period_s + (k / per_period) * on_s)
+        return out
+    if profile == "ramp":
+        r1 = ramp_to_hz or 2.0 * rate_hz
+        # mean rate == rate_hz: start low enough that the ramp averages
+        # out (r0 + r1) / 2 == rate_hz
+        r0 = max(0.0, 2.0 * rate_hz - r1)
+        t_ = duration_s
+        out = []
+        for i in range(n):
+            # invert the cumulative count N(t) = r0 t + (r1-r0) t^2 / 2T
+            a = (r1 - r0) / (2.0 * t_)
+            if a <= 0:
+                out.append(i / rate_hz)
+                continue
+            # solve a t^2 + r0 t - i = 0 for t >= 0
+            t = (-r0 + (r0 * r0 + 4.0 * a * i) ** 0.5) / (2.0 * a)
+            out.append(min(t, t_))
+        return out
+    raise ValueError(f"profile must be steady|burst|ramp, got {profile!r}")
+
+
+class OpenLoopLoad:
+    """Fire per-tenant schedules against ``submit(tenant)`` in real
+    time, OPEN-loop: arrivals that fell due while the driver was asleep
+    (scheduler jitter on a loaded box) are fired immediately in catch-up
+    — the offered count over the run is exactly the schedule's, never
+    throttled by the system under test.
+
+    ``schedules`` maps tenant name -> arrival offsets (seconds; from
+    :func:`arrival_schedule`). ``run()`` blocks until every schedule
+    drains and returns ``{tenant: offered_count}``; ``start()`` +
+    ``join()`` split that for concurrent measurement."""
+
+    def __init__(self, submit, schedules: dict):
+        self._submit = submit
+        self._schedules = {t: sorted(s) for t, s in schedules.items()}
+        self._threads = []
+        self.offered = {t: 0 for t in schedules}
+
+    def _drive(self, tenant: str, schedule):
+        t0 = time.monotonic()
+        n = 0
+        for off in schedule:
+            lag = (t0 + off) - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            self._submit(tenant)
+            n += 1
+        self.offered[tenant] = n
+
+    def start(self) -> "OpenLoopLoad":
+        for tenant, schedule in self._schedules.items():
+            t = threading.Thread(
+                target=self._drive, args=(tenant, schedule),
+                daemon=True, name=f"openloop-{tenant}",
+            )
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def join(self, timeout_s: float = 600.0) -> dict:
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return dict(self.offered)
+
+    def run(self, timeout_s: float = 600.0) -> dict:
+        return self.start().join(timeout_s)
 
 
 class _Fault:

@@ -1,0 +1,38 @@
+"""KNOWN-BAD: a sleep smuggled into the serving gateway dispatch loop.
+
+The gateway's dispatch loop sits directly on the latency SLO (ISSUE
+12): a ``time.sleep`` pacing the idle wait — instead of the bounded,
+offer()-woken Event wait — holds every tenant's admitted frames toward
+their deadlines, and an unbounded queue pop in the transport pump does
+the same through the ``get_batch`` seed edge (blocking-hot-path)."""
+
+import time
+
+
+class ServingGateway:
+    def offer(self, rec, tenant="default"):
+        self._q.append((tenant, rec))
+        return True
+
+    def dispatch_once(self):
+        if not self._q:
+            return 0
+        tenant, rec = self._q.popleft()
+        self._dispatch([rec], 1)
+        return 1
+
+    def run(self, stop=None):
+        while stop is None or not stop.is_set():
+            if self.dispatch_once() == 0:
+                time.sleep(0.02)  # MUST FLAG: unbounded idle pacing
+
+    def serve_queue(self, queue):
+        pop = getattr(queue, "get_batch_stream", None) or queue.get_batch
+        while True:
+            items = pop(16, timeout=0.01)
+            if not items:
+                return
+            for item in items:
+                self.offer(item)
+            while self.dispatch_once():
+                pass

@@ -1,0 +1,43 @@
+"""SANCTIONED: the serving gateway's bounded-wait idioms.
+
+Idle pauses ride a bounded Event wait that ``offer`` wakes (an idle
+gateway reacts to a new frame immediately, and the timeout bounds the
+worst case); transport pops carry explicit timeouts. None may flag
+(blocking-hot-path)."""
+
+import threading
+
+
+class ServingGateway:
+    def __init__(self):
+        self._q = []
+        self._work = threading.Event()
+
+    def offer(self, rec, tenant="default"):
+        self._q.append((tenant, rec))
+        self._work.set()
+        return True
+
+    def dispatch_once(self):
+        if not self._q:
+            return 0
+        tenant, rec = self._q.pop(0)
+        self._dispatch([rec], 1)
+        return 1
+
+    def run(self, stop=None):
+        while stop is None or not stop.is_set():
+            if self.dispatch_once() == 0:
+                self._work.wait(timeout=0.02)  # bounded + offer()-woken
+                self._work.clear()
+
+    def serve_queue(self, queue):
+        pop = getattr(queue, "get_batch_stream", None) or queue.get_batch
+        while True:
+            items = pop(16, timeout=0.01)
+            if not items:
+                return
+            for item in items:
+                self.offer(item)
+            while self.dispatch_once():
+                pass

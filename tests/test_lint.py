@@ -579,6 +579,41 @@ def test_blocking_checker_covers_the_cluster_merge_drain():
     assert not real.findings, real.findings
 
 
+def test_blocking_checker_covers_the_gateway_dispatch():
+    """ISSUE 12 satellite: the serving gateway's dispatch loop is
+    inside the blocking-hot-path audited graph — its own ROOTS entries
+    plus the same ``get_batch*`` seed edges as batches_from_queue on
+    serve_queue's getattr drain preference. A sleep pacing the idle
+    wait must flag (fixture pair), and the REAL ServingGateway must
+    scan clean (its idle pause is a bounded, offer()-woken Event
+    wait)."""
+    bad = FIXTURES / "gateway_dispatch_bad.py"
+    good = FIXTURES / "gateway_dispatch_good.py"
+    flagged = run_lint(paths=[bad], checkers=["blocking-hot-path"], use_allowlist=False)
+    hits = [
+        f for f in flagged.findings
+        if "time.sleep" in f.message and "ServingGateway.run" in f.message
+    ]
+    assert hits, flagged.findings
+    clean = run_lint(paths=[good], checkers=["blocking-hot-path"], use_allowlist=False)
+    assert not clean.findings, clean.findings
+    # ...and the shipped gateway is in the audited set with no findings
+    serving_dir = REPO_ROOT / "psana_ray_tpu" / "serving"
+    batcher = REPO_ROOT / "psana_ray_tpu" / "infeed" / "batcher.py"
+    real = run_lint(
+        paths=[*sorted(serving_dir.glob("*.py")), batcher],
+        checkers=["blocking-hot-path"],
+    )
+    assert not real.findings, real.findings
+    # reachability, not just absence-of-findings: the gateway roots and
+    # serve_queue's drain seeds must be declared
+    from psana_ray_tpu.lint.checkers.blocking import ROOTS, SEED_EDGES
+
+    assert "ServingGateway.serve_queue" in ROOTS
+    assert "ServingGateway.dispatch_once" in ROOTS
+    assert "get_batch_stream" in SEED_EDGES["serve_queue"]
+
+
 def test_event_loop_checker_roots_resolve_and_real_loop_is_clean():
     """ISSUE 6 satellite: the event-loop-blocking checker must root at
     the REAL loop dispatch (EventLoop.run) and find the shipped loop

@@ -1,0 +1,710 @@
+"""SLO-aware serving gateway (ISSUE 12): admission, deadline shedding,
+weighted fair-share, the escalate/restore cycle, and the zero-copy pin
+through the gateway path.
+
+The gateway tests run against an INJECTED clock and a simulated device
+(dispatch advances the clock by the operating point's service time), so
+control behavior — what gets admitted, shed, and served at which batch
+size — is deterministic on a loaded box. The transport-level WDRR test
+runs against a real event-loop server with raw streamed sockets so the
+tenant hello is exercised both ways on the wire.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from faultproxy import OpenLoopLoad, arrival_schedule
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.stall import StallDetector
+from psana_ray_tpu.records import EndOfStream, FrameRecord
+from psana_ray_tpu.serving import (
+    GatewayTelemetry,
+    PATH_ADMISSION,
+    PATH_DEADLINE,
+    PATH_STALL,
+    ServingGateway,
+    SloPolicy,
+    make_batch_dispatch,
+)
+from psana_ray_tpu.transport.ring import RingBuffer
+from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+from psana_ray_tpu.utils.bufpool import WIRE, BufferPool
+
+OPS = ((1, 0.89), (2, 1.43), (4, 2.45), (8, 4.33))
+
+
+def _rec(idx=0, shape=(2, 4, 8), dtype=np.float32, rank=0, energy=9.5):
+    panels = np.arange(int(np.prod(shape)), dtype=dtype).reshape(shape) + idx
+    return FrameRecord(rank, idx, panels, energy, timestamp=1.25)
+
+
+class _SimClock:
+    """Injectable monotonic clock + a simulated device: dispatch
+    advances time by the operating point's service latency."""
+
+    def __init__(self, policy=None):
+        self.t = 0.0
+        self.policy = policy
+        self.dispatched = []  # (tenant-agnostic) record lists
+        self.batch_sizes = []
+
+    def __call__(self):
+        return self.t
+
+    def device(self, recs, batch_size):
+        self.dispatched.extend(recs)
+        self.batch_sizes.append(batch_size)
+        self.t += dict(OPS)[batch_size] / 1000.0
+
+
+def _gateway(slo_ms=25.0, weights=None, **kw):
+    policy = SloPolicy(slo_ms=slo_ms, operating_points=OPS, ewma=0.0)
+    clock = _SimClock(policy)
+    gw = ServingGateway(
+        clock.device, policy=policy, weights=weights, clock=clock,
+        telemetry=GatewayTelemetry(register=False), **kw
+    )
+    return gw, clock
+
+
+# ---------------------------------------------------------------------------
+# policy: the frontier as a control law
+# ---------------------------------------------------------------------------
+
+class TestSloPolicy:
+    def test_idle_serves_b1_loaded_serves_b8(self):
+        p = SloPolicy(operating_points=OPS)
+        assert p.choose_batch(0) == 1
+        assert p.choose_batch(1) == 1
+        assert p.choose_batch(3) == 2
+        assert p.choose_batch(8) == 8
+        assert p.choose_batch(10_000) == 8
+
+    def test_slo_guard_steps_down_an_unservable_point(self):
+        # B8's device time alone exceeds a 3 ms SLO: never choose it
+        p = SloPolicy(slo_ms=3.0, operating_points=OPS)
+        assert p.choose_batch(10_000) == 4
+
+    def test_observe_service_refines_the_table(self):
+        p = SloPolicy(operating_points=OPS, ewma=1.0)
+        p.observe_service(8, 10.0)
+        assert p.service_ms(8) == pytest.approx(10.0)
+        assert p.capacity_fps() == pytest.approx(
+            max(8 / 10.0 * 1000.0, 4 / 2.45 * 1000.0)
+        )
+
+    def test_budget_shrinks_while_degraded(self):
+        p = SloPolicy(slo_ms=20.0, shed_margin=0.9, degraded_margin=0.5)
+        assert p.budget_ms(False) == pytest.approx(18.0)
+        assert p.budget_ms(True) == pytest.approx(10.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):  # duplicate batch size
+            SloPolicy(operating_points=[(2, 0.5), (2, 1.0)])
+        with pytest.raises(ValueError):  # non-positive service time
+            SloPolicy(operating_points=[(1, 0.0)])
+        with pytest.raises(ValueError):
+            SloPolicy(slo_ms=0)
+        with pytest.raises(ValueError):  # margins out of order
+            SloPolicy(shed_margin=0.4, degraded_margin=0.6)
+
+
+# ---------------------------------------------------------------------------
+# gateway: admission, deadlines, adaptivity
+# ---------------------------------------------------------------------------
+
+class TestGatewayControl:
+    def test_idle_frame_dispatches_at_b1(self):
+        gw, clock = _gateway()
+        assert gw.offer(_rec(0))
+        assert gw.dispatch_once() == 1
+        assert clock.batch_sizes == [1]
+        assert gw.telemetry.stats()["batch_last"] == 1
+
+    def test_backlog_dispatches_at_b8(self):
+        gw, clock = _gateway(slo_ms=1000.0)
+        for i in range(16):
+            assert gw.offer(_rec(i))
+        gw.dispatch_once()
+        assert clock.batch_sizes == [8]
+
+    def test_admission_sheds_past_the_budget_and_conserves(self):
+        gw, clock = _gateway(slo_ms=25.0)
+        admitted = shed = 0
+        for i in range(500):  # one instant: far beyond an SLO of backlog
+            if gw.offer(_rec(i)):
+                admitted += 1
+            else:
+                shed += 1
+        assert 0 < admitted < 100  # ~a budget's worth, not everything
+        assert shed == 500 - admitted
+        while gw.dispatch_once():
+            pass
+        s = gw.telemetry.stats()
+        assert s["offered_total"] == 500
+        assert s["offered_total"] == s["completed_total"] + s["shed_total"]
+        assert s["shed_admission_total"] == shed
+        # everything admitted completed INSIDE the SLO (that is what the
+        # admission predicate promised)
+        assert s["goodput_total"] == s["completed_total"]
+        assert s["slo_attainment"] == 1.0
+
+    def test_dequeue_recheck_sheds_aged_out_frames_loudly(self):
+        gw, clock = _gateway(slo_ms=25.0)
+        for i in range(4):
+            assert gw.offer(_rec(i))
+        before = FLIGHT.count_of("gateway_shed")
+        clock.t += 1.0  # everything aged out while queued
+        handled = gw.dispatch_once()
+        assert handled == 4
+        assert clock.dispatched == []  # never processed late
+        s = gw.telemetry.stats()
+        assert s["shed_deadline_total"] == 4
+        assert FLIGHT.count_of("gateway_shed") > before
+
+    def test_explicit_deadline_beats_the_slo_default(self):
+        gw, clock = _gateway(slo_ms=1000.0)
+        assert not gw.offer(_rec(0), deadline=clock.t + 0.0001)
+        s = gw.telemetry.stats()
+        assert s["shed_admission_total"] == 1
+
+    def test_service_feedback_reaches_the_policy(self):
+        gw, clock = _gateway(slo_ms=1000.0)
+        gw.policy._ewma = 1.0  # full-step for the pin
+        assert gw.offer(_rec(0))
+        gw.dispatch_once()
+        # the simulated device took exactly the B1 point; EWMA kept it
+        assert gw.policy.service_ms(1) == pytest.approx(0.89, rel=0.05)
+
+
+class TestShedNeverSilent:
+    """ISSUE 12 satellite: every shed path increments the SAME counter
+    family and leaves a breadcrumb; the conservation identity holds
+    across all three."""
+
+    def test_all_three_paths_count_and_crumb_and_conserve(self):
+        gw, clock = _gateway(slo_ms=25.0)
+        crumbs0 = FLIGHT.count_of("gateway_shed")
+        offered = 0
+        # path 1 — admission: flood one instant far past the budget
+        for i in range(300):
+            gw.offer(_rec(i))
+            offered += 1
+        # path 2 — stall escalation: a frame that fits the NORMAL budget
+        # but not the degraded one. Drain most of the backlog first so
+        # predicted sojourn sits between the two budgets.
+        while gw.backlog() > 20:
+            gw.dispatch_once()
+        gw.escalate("test")
+        assert gw.degraded
+        stall_shed = 0
+        for i in range(40):
+            if not gw.offer(_rec(1000 + i)):
+                stall_shed += 1
+            offered += 1
+        gw.restore()
+        assert not gw.degraded
+        # path 3 — dequeue age-out: park admitted frames past deadline
+        clock.t += 1.0
+        while gw.dispatch_once():
+            pass
+        s = gw.telemetry.stats()
+        by_path = gw.telemetry.shed_by_path()
+        assert by_path[PATH_ADMISSION] > 0
+        assert by_path[PATH_STALL] > 0 and by_path[PATH_STALL] == stall_shed
+        assert by_path[PATH_DEADLINE] > 0
+        assert s["shed_total"] == sum(by_path.values())
+        # conservation: nothing silent anywhere
+        assert s["offered_total"] == offered
+        assert gw.backlog() == 0
+        assert s["offered_total"] == s["completed_total"] + s["shed_total"]
+        # each path left at least one breadcrumb (first shed always does)
+        assert FLIGHT.count_of("gateway_shed") >= crumbs0 + 3
+
+
+class TestWeightedFairShare:
+    def test_goodput_tracks_weights_under_sustained_overload(self):
+        """3:1 weights, equal offered load at ~2.2x capacity: goodput
+        shares converge to the weights within 10%."""
+        gw, clock = _gateway(slo_ms=20.0, weights={"a": 3, "b": 1})
+        rate = 2000.0  # per tenant; capacity ~1848 total => ~2.2x
+        next_at = {"a": 0.0, "b": 0.0}
+        i = 0
+        while clock.t < 2.0:
+            for t in ("a", "b"):
+                while next_at[t] <= clock.t:
+                    gw.offer(_rec(i), tenant=t)
+                    next_at[t] += 1.0 / rate
+                    i += 1
+            if gw.dispatch_once() == 0:
+                clock.t += 0.001
+        goodput = gw.telemetry.tenant_goodput()
+        share = goodput["a"] / max(1, goodput["a"] + goodput["b"])
+        assert 0.75 * 0.9 <= share <= min(1.0, 0.75 * 1.1), goodput
+        s = gw.telemetry.stats()
+        # overload: real shedding happened, and loudly
+        assert s["shed_total"] > 0
+        assert s["offered_total"] == (
+            s["completed_total"] + s["shed_total"] + gw.backlog()
+        )
+
+
+# ---------------------------------------------------------------------------
+# stall detector: escalate / restore acts on the gateway
+# ---------------------------------------------------------------------------
+
+class _FakeQueue:
+    def __init__(self):
+        self.depth = 0
+        self.maxsize = 8
+        self.puts = 0
+        self.gets = 0
+
+    def stats(self):
+        return {
+            "depth": self.depth, "maxsize": self.maxsize,
+            "puts": self.puts, "gets": self.gets,
+        }
+
+
+class TestStallEscalation:
+    def test_fire_escalates_clear_restores(self):
+        gw, _clock = _gateway()
+        cleared = []
+        det = StallDetector(
+            full_threshold_s=1.0, idle_threshold_s=1.0,
+            on_clear=lambda: cleared.append(True),
+        )
+        q = _FakeQueue()
+        det.watch("q", q).bind_gateway(gw)
+        # healthy polls: nothing happens
+        q.depth, q.puts, q.gets = 2, 10, 8
+        det.poll_once(now=100.0)
+        assert not det.degraded and not gw.degraded
+        # queue pegs at maxsize past the threshold: fire
+        q.depth, q.puts = q.maxsize, 20
+        det.poll_once(now=101.0)
+        det.poll_once(now=103.0)
+        assert det.degraded
+        assert det.snapshot()["degraded"] == 1
+        assert gw.degraded  # the detector ACTED, not just warned
+        assert gw.telemetry.stats()["escalations"] == 1
+        # condition clears: restore
+        q.depth, q.gets = 1, 25
+        det.poll_once(now=104.0)
+        assert not det.degraded
+        assert det.snapshot()["degraded"] == 0
+        assert not gw.degraded
+        assert cleared == [True]
+        assert gw.telemetry.stats()["restores"] == 1
+
+    def test_bind_mid_episode_escalates_immediately(self):
+        det = StallDetector(full_threshold_s=0.5)
+        q = _FakeQueue()
+        q.depth = q.maxsize
+        det.watch("q", q)
+        det.poll_once(now=10.0)
+        det.poll_once(now=11.0)
+        assert det.degraded
+        gw, _ = _gateway()
+        det.bind_gateway(gw)
+        assert gw.degraded
+
+    def test_dead_queue_cannot_latch_the_degraded_gauge(self):
+        """A queue whose transport dies (stats raises) or that leaves
+        the watch population mid-episode must not hold bound gateways
+        escalated forever — its unobservable episode is dropped."""
+        gw, _ = _gateway()
+        det = StallDetector(full_threshold_s=0.5)
+        q = _FakeQueue()
+        q.depth = q.maxsize
+        det.watch("q", q).bind_gateway(gw)
+        det.poll_once(now=10.0)
+        det.poll_once(now=11.0)
+        assert det.degraded and gw.degraded
+        # the transport dies: stats() raises from now on
+        def _boom():
+            raise RuntimeError("transport closed")
+        q.stats = _boom
+        det.poll_once(now=12.0)
+        assert not det.degraded
+        assert not gw.degraded
+        # same for a queue that simply vanishes from a provider
+        det2 = StallDetector(full_threshold_s=0.5)
+        pop = {"q": _FakeQueue()}
+        pop["q"].depth = pop["q"].maxsize
+        det2.watch_provider(lambda: pop)
+        det2.poll_once(now=20.0)
+        det2.poll_once(now=21.0)
+        assert det2.degraded
+        pop.clear()
+        det2.poll_once(now=22.0)
+        assert not det2.degraded
+
+
+class TestDispatchSerialization:
+    def test_run_thread_and_drain_never_reenter_the_dispatch(self):
+        """dispatch callables (make_batch_dispatch's FrameBatcher
+        arenas) are not thread-safe: a run() loop racing a drain()
+        caller must serialize through the gateway, never re-enter."""
+        concurrent = []
+        active = threading.Semaphore(1)
+
+        def dispatch(recs, batch_size):
+            if not active.acquire(blocking=False):
+                concurrent.append(True)
+                return
+            try:
+                time.sleep(0.002)
+            finally:
+                active.release()
+
+        gw = ServingGateway(
+            dispatch,
+            policy=SloPolicy(slo_ms=10_000.0, operating_points=OPS),
+            telemetry=GatewayTelemetry(register=False),
+        )
+        stop = threading.Event()
+        loop = threading.Thread(target=gw.run, args=(stop,), daemon=True)
+        loop.start()
+        for i in range(200):
+            gw.offer(_rec(i))
+            if i % 16 == 0:
+                gw.drain(deadline_s=0.01)  # racing dispatcher
+        gw.drain(deadline_s=10.0)
+        stop.set()
+        loop.join(timeout=5)
+        assert not concurrent
+        assert gw.backlog() == 0
+        s = gw.telemetry.stats()
+        assert s["completed_total"] == 200 and s["shed_total"] == 0
+
+
+class TestTenantArgs:
+    def test_weight_without_tenant_refuses_loudly(self):
+        import argparse
+
+        from psana_ray_tpu.config import TransportConfig
+        from psana_ray_tpu.transport.addressing import (
+            add_tenant_args,
+            apply_tenant_args,
+        )
+
+        p = argparse.ArgumentParser()
+        add_tenant_args(p)
+        cfg = TransportConfig()
+        # weight with no tenant: refuse, never silently drop
+        with pytest.raises(ValueError, match="requires --tenant"):
+            apply_tenant_args(cfg, p.parse_args(["--tenant_weight", "8"]))
+        # out-of-range weight validated even without a tenant
+        with pytest.raises(ValueError, match="1, 64"):
+            apply_tenant_args(cfg, p.parse_args(["--tenant_weight", "999"]))
+        # the good path round-trips
+        out = apply_tenant_args(
+            cfg, p.parse_args(["--tenant", "a", "--tenant_weight", "8"])
+        )
+        assert out.tenant == "a" and out.tenant_weight == 8
+        # defaults pass through untouched
+        assert apply_tenant_args(cfg, p.parse_args([])) is cfg
+
+
+# ---------------------------------------------------------------------------
+# batch adapter: fixed-shape batches, padded tails, zero-copy
+# ---------------------------------------------------------------------------
+
+class TestMakeBatchDispatch:
+    def test_pads_partial_dispatches_and_reuses_per_size_batchers(self):
+        batches = []
+        dispatch = make_batch_dispatch(batches.append)
+        dispatch([_rec(0), _rec(1), _rec(2)], 4)
+        assert len(batches) == 1
+        assert batches[0].frames.shape[0] == 4
+        assert batches[0].num_valid == 3
+        assert list(batches[0].valid) == [1, 1, 1, 0]
+        dispatch([_rec(3)], 1)
+        assert batches[1].num_valid == 1 and batches[1].batch_size == 1
+        # a full dispatch emits exactly once, unpadded
+        dispatch([_rec(i) for i in range(4, 8)], 4)
+        assert batches[2].num_valid == 4
+
+
+class TestGatewayTransportPath:
+    """serve_queue: the consumer drive path behind a gateway — EOS
+    semantics and the zero-copy pins, over a real TCP server."""
+
+    def _run_gateway_relay(self, n, pool=None, slo_ms=10_000.0):
+        q = RingBuffer(64)
+        srv = TcpQueueServer(q, host="127.0.0.1", pool=pool).serve_background()
+        prod = TcpQueueClient("127.0.0.1", srv.port, pool=pool)
+        cons = TcpQueueClient("127.0.0.1", srv.port, pool=pool)
+        batches = []
+        gw = ServingGateway(
+            make_batch_dispatch(batches.append),
+            policy=SloPolicy(slo_ms=slo_ms, operating_points=OPS),
+            telemetry=GatewayTelemetry(register=False),
+        )
+        try:
+            def produce():
+                for i in range(n):
+                    assert prod.put_wait(_rec(i, shape=(2, 16, 16)), timeout=30)
+                assert prod.put_wait(EndOfStream(total_events=n), timeout=30)
+
+            t = threading.Thread(target=produce, daemon=True)
+            c0 = WIRE.stats()
+            t.start()
+            gw.serve_queue(cons, max_wait_s=60.0)
+            t.join(timeout=30)
+            d = WIRE.stats()
+            return gw, batches, (
+                d["copies_total"] - c0["copies_total"],
+                d["bytes_copied_total"] - c0["bytes_copied_total"],
+            )
+        finally:
+            prod.disconnect()
+            cons.disconnect()
+            srv.shutdown()
+            from psana_ray_tpu.transport.ring import EMPTY as _EMPTY
+
+            while True:  # redelivered at-least-once tail: release leases
+                item = q.get()
+                if item is _EMPTY:
+                    break
+                release = getattr(item, "release", None)
+                if release is not None:
+                    release()
+
+    def test_serve_queue_processes_everything_and_stops_at_eos(self):
+        n = 24
+        gw, batches, _ = self._run_gateway_relay(n)
+        seen = sum(b.num_valid for b in batches)
+        assert seen == n
+        s = gw.telemetry.stats()
+        assert s["offered_total"] == n
+        assert s["completed_total"] == n and s["shed_total"] == 0
+
+    def test_zero_copy_pins_hold_through_the_gateway(self):
+        """Acceptance: copies/frame == 1.00 (the one batch-arena
+        memcpy) and steady-state pool churn == 0 through serve_queue +
+        make_batch_dispatch — the gateway adds control, not copies."""
+        pool = BufferPool()
+        n = 24
+        gw, batches, (copies, nbytes) = self._run_gateway_relay(n, pool=pool)
+        assert sum(b.num_valid for b in batches) == n
+        assert copies == n, f"expected exactly 1 copy/frame, got {copies}/{n}"
+        assert nbytes == n * _rec(0, shape=(2, 16, 16)).nbytes
+        s = pool.stats()
+        assert s["churn_misses"] == 0, (
+            f"gateway path churned {s['churn_misses']} allocations ({s})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# transport WDRR: the tenant hello on the wire, weighted stream pump
+# ---------------------------------------------------------------------------
+
+def _subscribe_raw(port, advert, window):
+    s = socket.create_connection(("127.0.0.1", port), timeout=30.0)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    payload = advert.encode()
+    s.sendall(b"Z" + struct.pack("<H", len(payload)) + payload)
+    assert _recv_exact(s, 1) == b"1"
+    (k,) = struct.unpack("<H", _recv_exact(s, 2))
+    chosen = _recv_exact(s, k).decode()
+    assert chosen == "none"
+    s.sendall(b"M" + struct.pack("<I", window))
+    return s
+
+
+def _recv_exact(s, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _count_pushes(s, counts, idx):
+    """Read pushed frames off a raw streamed socket until it goes quiet."""
+    s.settimeout(1.0)
+    try:
+        while True:
+            st = _recv_exact(s, 1)
+            assert st == b"1"
+            _seq, ln = struct.unpack("<QI", _recv_exact(s, 12))
+            _recv_exact(s, ln)
+            counts[idx] += 1
+    except (socket.timeout, ConnectionError):
+        return
+
+
+class TestEvloopWdrr:
+    def test_tenant_hello_reaches_the_server(self):
+        q = RingBuffer(16)
+        srv = TcpQueueServer(q, host="127.0.0.1").serve_background()
+        try:
+            before = FLIGHT.count_of("tenant_hello")
+            c = TcpQueueClient(
+                "127.0.0.1", srv.port, tenant="alice", tenant_weight=4
+            )
+            assert c.put(_rec(0))
+            assert FLIGHT.count_of("tenant_hello") == before + 1
+            evt = [e for e in FLIGHT.events() if e["kind"] == "tenant_hello"][-1]
+            assert evt["tenant"] == "alice" and evt["weight"] == 4
+            c.disconnect()
+        finally:
+            srv.shutdown()
+
+    def test_tenant_name_validation(self):
+        with pytest.raises(ValueError):
+            TcpQueueClient("127.0.0.1", 1, tenant="a,b")
+        with pytest.raises(ValueError):
+            TcpQueueClient("127.0.0.1", 1, tenant="a", tenant_weight=0)
+        with pytest.raises(ValueError):
+            TcpQueueClient("127.0.0.1", 1, tenant="a", tenant_weight=65)
+
+    def test_stream_pump_splits_backlog_by_tenant_weight(self):
+        """Two streamed subscribers, tenants 3:1, one shared backlog:
+        delivered counts converge to the weight shares — the greedy
+        tenant cannot take the queue. Exercises the hello both ways on
+        the wire (client advertises, server pump honors)."""
+        n = 320
+        q = RingBuffer(n + 8)
+        srv = TcpQueueServer(q, host="127.0.0.1").serve_background()
+        socks = []
+        try:
+            socks.append(_subscribe_raw(srv.port, "none,tenant=heavy:3", n))
+            socks.append(_subscribe_raw(srv.port, "none,tenant=light:1", n))
+            time.sleep(0.1)  # both subscriptions parked in the pump
+            rec = _rec(0, shape=(2, 8, 8))
+            for _ in range(n):
+                assert q.put(rec)
+            counts = [0, 0]
+            threads = [
+                threading.Thread(
+                    target=_count_pushes, args=(s, counts, i), daemon=True
+                )
+                for i, s in enumerate(socks)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert sum(counts) == n, counts
+            heavy_share = counts[0] / n
+            assert 0.75 * 0.85 <= heavy_share <= 0.75 * 1.15, counts
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            srv.shutdown()
+
+    def test_untenanted_streams_share_the_default_budget(self):
+        """No hello anywhere: pre-ISSUE-12 behavior — two anonymous
+        subscribers split the backlog roughly evenly (round-robin)."""
+        n = 160
+        q = RingBuffer(n + 8)
+        srv = TcpQueueServer(q, host="127.0.0.1").serve_background()
+        socks = []
+        try:
+            for _ in range(2):
+                s = socket.create_connection(("127.0.0.1", srv.port), timeout=30.0)
+                s.sendall(b"M" + struct.pack("<I", n))
+                socks.append(s)
+            time.sleep(0.1)
+            rec = _rec(0, shape=(2, 8, 8))
+            for _ in range(n):
+                assert q.put(rec)
+            counts = [0, 0]
+            threads = [
+                threading.Thread(
+                    target=_count_pushes, args=(s, counts, i), daemon=True
+                )
+                for i, s in enumerate(socks)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert sum(counts) == n, counts
+            assert 0.3 <= counts[0] / n <= 0.7, counts
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# open-loop burst generation (tests/faultproxy.py satellite)
+# ---------------------------------------------------------------------------
+
+class TestArrivalSchedules:
+    def test_steady_spacing_and_count(self):
+        s = arrival_schedule("steady", 100.0, 2.0)
+        assert len(s) == 200
+        assert s[0] == 0.0
+        diffs = [b - a for a, b in zip(s, s[1:])]
+        assert all(abs(d - 0.01) < 1e-9 for d in diffs)
+
+    def test_burst_concentrates_arrivals_in_the_on_window(self):
+        s = arrival_schedule(
+            "burst", 100.0, 2.0, burst_factor=4.0, period_s=1.0
+        )
+        assert len(s) == 200
+        for t in s:
+            # every arrival inside the first quarter of its period
+            assert (t % 1.0) <= 0.25 + 1e-9, t
+
+    def test_ramp_is_monotonic_and_ends_hot(self):
+        s = arrival_schedule("ramp", 100.0, 2.0, ramp_to_hz=200.0)
+        assert len(s) == 200
+        assert all(b >= a for a, b in zip(s, s[1:]))
+        assert s[-1] <= 2.0
+        # more arrivals in the second half than the first (the ramp)
+        late = sum(1 for t in s if t >= 1.0)
+        assert late > len(s) * 0.55
+
+    def test_mean_rate_is_preserved_across_profiles(self):
+        for profile in ("steady", "burst", "ramp"):
+            s = arrival_schedule(profile, 50.0, 4.0)
+            assert len(s) == 200, profile
+
+    def test_burst_fractional_per_period_keeps_the_mean_rate(self):
+        # rate_hz * period_s < 2: int() truncation here used to realize
+        # one arrival per period (half the documented mean rate) and
+        # stretch the schedule to ~2x the duration
+        s = arrival_schedule("burst", 12.0, 5.0, period_s=0.15)
+        assert len(s) == 60
+        assert s[-1] < 5.0 + 0.15, s[-1]
+        assert all(b >= a for a, b in zip(s, s[1:]))
+
+    def test_unknown_profile_raises(self):
+        with pytest.raises(ValueError):
+            arrival_schedule("poisson", 10, 1)
+
+    def test_open_loop_load_fires_the_whole_schedule(self):
+        seen = {"a": 0, "b": 0}
+        lock = threading.Lock()
+
+        def submit(tenant):
+            with lock:
+                seen[tenant] += 1
+
+        load = OpenLoopLoad(submit, {
+            "a": arrival_schedule("steady", 400.0, 0.2),
+            "b": arrival_schedule("burst", 200.0, 0.2, period_s=0.05),
+        })
+        offered = load.run(timeout_s=30.0)
+        assert offered == {"a": 80, "b": 40}
+        assert seen == offered

@@ -172,6 +172,140 @@ def emit_final():
         pass  # side file is best-effort; stdout is the artifact of record
 
 
+# ---------------------------------------------------------------------------
+# Baseline regression gate (ISSUE 13): `bench.py --baseline BENCH_rXX.json`
+# compares this run's key rows against a prior artifact and embeds a
+# `regressions` list in bench_full.json. The gate is DATA, not an exit
+# code — the driver (and the tier-1 test on a synthetic pair) reads the
+# list; a flaky box must not turn the bench red by itself.
+# ---------------------------------------------------------------------------
+
+# (rule name, key predicate, direction, relative tolerance, absolute floor).
+# Direction "higher": current < baseline*(1-tol) is a regression;
+# "lower": current > baseline*(1+tol). The absolute floor suppresses
+# noise on near-zero values (copies/allocs pins use it as the whole
+# tolerance).
+_BASELINE_RULES = (
+    ("fps", lambda k: k.endswith("_fps") or k.endswith("fps_at_operating_point")
+     or k == "value", "higher", 0.15, 1e-9),
+    ("latency_ms", lambda k: k.endswith("p99_ms") or k.endswith("p95_ms")
+     or k.endswith("p50_ms") or k.endswith("_ms_per_frame")
+     or k.endswith("ms_per_dispatch"), "lower", 0.25, 1e-9),
+    ("copies_per_frame", lambda k: k.endswith("copies_per_frame"),
+     "lower", 0.0, 0.05),
+    ("allocs_per_frame", lambda k: k.endswith("allocs_per_frame"),
+     "lower", 0.0, 0.05),
+    ("compression_ratio", lambda k: "ratio" in k.rsplit(".", 1)[-1],
+     "higher", 0.15, 1e-9),
+    ("quality", lambda k: k.endswith("accuracy") or k.endswith("recall")
+     or k.endswith("precision"), "higher", 0.0, 0.02),
+    ("lost_frames", lambda k: k.endswith("_lost") or k.endswith(".lost"),
+     "lower", 0.0, 0.0),
+)
+
+
+def _flatten_artifact(tree) -> dict:
+    """Numeric leaves of a bench artifact as {dotted.key: float} — THE
+    shared flattening grammar (obs.registry.flatten_numeric: bools as
+    0/1, exemplars subtree skipped, non-finite/non-numeric dropped), so
+    the baseline gate compares exactly the keys the history rings and
+    /metrics record. Lists are ignored by the grammar (row dumps)."""
+    from psana_ray_tpu.obs.registry import flatten_numeric
+
+    leaves: list = []
+    flatten_numeric((), tree if isinstance(tree, dict) else {}, leaves)
+    return dict(leaves)
+
+
+def load_baseline_artifact(path: str) -> dict:
+    """A prior artifact's comparable dict: accepts a driver round file
+    (``BENCH_rXX.json`` — the numbers live under ``parsed``) or a
+    ``bench_full.json``. Raises on unreadable/unparseable input — the
+    caller decides whether that kills anything (main() never lets it)."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"baseline {path} is not a JSON object")
+    return doc
+
+
+def compare_baseline(current: dict, baseline: dict) -> list:
+    """Key-row regression list between two artifacts (see
+    ``_BASELINE_RULES``). Only keys present AND numeric in both compare;
+    each regression carries the rule, both values, and the relative
+    change so the driver/README can render it without re-deriving."""
+    cur = _flatten_artifact(current)
+    base = _flatten_artifact(baseline)
+    out = []
+    for key in sorted(set(cur) & set(base)):
+        b, c = base[key], cur[key]
+        for rule, match, direction, rel_tol, abs_floor in _BASELINE_RULES:
+            if not match(key):
+                continue
+            bound = max(abs(b) * rel_tol, abs_floor)
+            regressed = (
+                (b - c) > bound if direction == "higher" else (c - b) > bound
+            )
+            if regressed:
+                out.append(
+                    {
+                        "key": key,
+                        "rule": rule,
+                        "direction": direction,
+                        "baseline": b,
+                        "current": c,
+                        "change_pct": round((c - b) / b * 100.0, 2)
+                        if b else None,
+                        "tolerance": round(bound, 6),
+                    }
+                )
+            break  # first matching rule owns the key
+    return out
+
+
+def apply_baseline_gate(extras: dict, path) -> None:
+    """Embed the regression comparison in the artifact (never raises —
+    the gate must not cost the run its numbers)."""
+    if not path:
+        return
+    try:
+        baseline = load_baseline_artifact(path)
+        regressions = compare_baseline(extras, baseline)
+        cur_keys = set(_flatten_artifact(extras))
+        base_keys = set(_flatten_artifact(baseline))
+        extras["baseline_compared"] = {
+            "path": str(path),
+            "rows_compared": len(cur_keys & base_keys),
+            "regression_count": len(regressions),
+        }
+        extras["regressions"] = regressions
+        if regressions:
+            log(f"baseline gate vs {path}: {len(regressions)} regression(s)")
+            for r in regressions[:20]:
+                # change_pct is None when the baseline is 0 — the
+                # lost_frames rule's canonical case; render the
+                # absolute delta instead of a garbage "None%"
+                change = (
+                    f"{r['change_pct']}%" if r["change_pct"] is not None
+                    else f"{r['current'] - r['baseline']:+g} abs"
+                )
+                log(
+                    f"  REGRESSION [{r['rule']}] {r['key']}: "
+                    f"{r['baseline']} -> {r['current']} "
+                    f"({change}, tol {r['tolerance']})"
+                )
+        else:
+            log(
+                f"baseline gate vs {path}: clean over "
+                f"{extras['baseline_compared']['rows_compared']} shared rows"
+            )
+    except Exception as e:  # noqa: BLE001 — the gate is advisory data
+        extras["baseline_error"] = repr(e)
+        log(f"baseline gate failed: {e!r}")
+
+
 class SectionTimeout(BaseException):
     """Async-injected by the watchdog into the main thread when a section
     exceeds its budget. BaseException so library-level ``except
@@ -519,7 +653,17 @@ def device_time_ms(jax, fn, warm_args, fresh_args, label: str, extras=None):
     return med
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py")
+    ap.add_argument(
+        "--baseline", default=os.environ.get("BENCH_BASELINE"),
+        help="prior artifact (BENCH_rXX.json driver round or "
+        "bench_full.json) to compare key rows against; regressions land "
+        "in bench_full.json under `regressions` (ISSUE 13)",
+    )
+    args = ap.parse_args(argv)
     # emit whatever we have if the driver TERMs us before our own watchdog
     # fires (only helps when the main thread is in Python, but free)
     def _on_term(*_):
@@ -934,6 +1078,12 @@ def main():
         )
     if backend_dead:
         log("backend degraded — remaining device diagnostics skipped fast")
+
+    # ---------------- baseline regression gate (ISSUE 13) ----------------
+    # runs LAST so every section's keys participate; purely additive to
+    # the artifact (the driver reads `regressions`, the bench never
+    # exits non-zero over it)
+    apply_baseline_gate(extras, args.baseline)
 
     emit_final()
 
@@ -2254,15 +2404,18 @@ def _bench_host_datapath(extras, smoke=False):
     pool16 = [rng.integers(0, 4096, size=shape, dtype=np.uint16) for _ in range(4)]
     buf_pool = BufferPool.default()
 
-    def run_relay(streaming: bool):
+    def run_relay(streaming: bool, obs_hook=None):
         """One producer->server->batched-consumer pass; returns the
-        measured (fps, copies/frame, allocs/frame, growth/frame, pool)."""
+        measured (fps, copies/frame, allocs/frame, growth/frame, pool).
+        ``obs_hook(srv)`` (the ISSUE 13 sampling+collector A/B) may
+        attach observers to the live server and return a cleanup."""
         # queue depth bounds the pool's working set (every queued frame
         # holds a pooled lease): one batch of headroom keeps the relay
         # busy without ballooning retained buffers
         srv = TcpQueueServer(
             RingBuffer(batch_size), host="127.0.0.1"
         ).serve_background()
+        obs_cleanup = obs_hook(srv) if obs_hook is not None else None
         prod = TcpQueueClient("127.0.0.1", srv.port)
         cons = TcpQueueClient("127.0.0.1", srv.port)
 
@@ -2314,6 +2467,11 @@ def _bench_host_datapath(extras, smoke=False):
             growth = (m1["misses"] - m0["misses"]) / steady
             return fps, copies, allocs, growth, m1
         finally:
+            if obs_cleanup is not None:
+                try:
+                    obs_cleanup()
+                except Exception:  # noqa: BLE001 — observer teardown only
+                    pass
             for c in (prod, cons):
                 try:
                     c.disconnect()
@@ -2375,6 +2533,47 @@ def _bench_host_datapath(extras, smoke=False):
         f"steady-state (window peak {occupancy['inflight_peak']} in "
         f"flight, {occupancy['acks']} acks, "
         f"{occupancy['redelivered']} redelivered)"
+    )
+
+    # -- telemetry-plane overhead row (ISSUE 13) ---------------------------
+    # the SAME passthrough relay with the history sampler AND the
+    # federation collector polling the live server over the 'N' metrics
+    # RPC — at 5 Hz each, 5-10x the production default, so the measured
+    # delta is an upper bound. Acceptance: fps within noise of the
+    # sampling-off row above, copies/frame 1.00 / allocs 0 UNCHANGED
+    # (the telemetry plane reads counters; it must never touch frames).
+    def _obs_on(srv):
+        from psana_ray_tpu.obs.collector import ClusterCollector
+        from psana_ray_tpu.obs.timeseries import HistorySampler
+
+        sampler = HistorySampler(interval_s=0.2).start()
+        coll = ClusterCollector(
+            [f"127.0.0.1:{srv.port}"], interval_s=0.2, register=False
+        ).start()
+
+        def _cleanup():
+            sampler.stop()
+            coll.stop()
+            extras["host_datapath_obs_history"] = sampler.snapshot()
+            extras["host_datapath_obs_collector"] = coll.snapshot()
+
+        return _cleanup
+
+    fps_o, copies_o, allocs_o, _growth_o, _ = run_relay(
+        streaming=False, obs_hook=_obs_on
+    )
+    extras["host_datapath_obs_on_fps"] = round(fps_o, 1)
+    extras["host_datapath_obs_on_copies_per_frame"] = round(copies_o, 3)
+    extras["host_datapath_obs_on_allocs_per_frame"] = round(allocs_o, 3)
+    extras["host_datapath_obs_on_delta_pct"] = (
+        round((fps_o - fps) / fps * 100.0, 1) if fps else None
+    )
+    log(
+        f"host datapath [tcp relay + 5 Hz sampler + 5 Hz collector]: "
+        f"{fps_o:.0f} fps ({extras['host_datapath_obs_on_delta_pct']:+.1f}% "
+        f"vs sampling off), {copies_o:.2f} copies/frame, "
+        f"{allocs_o:.3f} allocs/frame — the telemetry plane reads "
+        f"counters, never frames"
     )
 
 

@@ -294,7 +294,7 @@ def main(argv=None):
         "seconds — the consumer-side mirror of the producer's end-of-run "
         "summary; 0 = off",
     )
-    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
     from psana_ray_tpu.transport.addressing import (
         add_cluster_args,
         add_tenant_args,
@@ -303,6 +303,7 @@ def main(argv=None):
 
     add_metrics_args(p)
     add_trace_args(p)
+    add_history_args(p)
     add_cluster_args(p, consumer=True)
     add_wire_args(p)
     add_tenant_args(p)
@@ -394,6 +395,10 @@ def main(argv=None):
     observe_dwell = a.status_interval > 0 or a.metrics_port > 0
     MetricsRegistry.default().register("consumer", metrics)
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    # history ring (ISSUE 13): flight-dump tails + /federate consumers
+    from psana_ray_tpu.obs import configure_history_from_args
+
+    history = configure_history_from_args(a)
     heartbeat_done = threading.Event()
     heartbeat = None
     if a.status_interval > 0:
@@ -450,9 +455,15 @@ def main(argv=None):
                     metrics.observe_frame(rec.nbytes)
                     if observe_dwell and rec.timestamp:
                         # wall-clock dwell (producer stamp -> this read):
-                        # exact same-host, approximate cross-host (NTP)
+                        # exact same-host, approximate cross-host (NTP).
+                        # A sampled frame's trace id rides the bucket as
+                        # its exemplar (trace_merge --exemplar, ISSUE 13)
+                        _tr = rec.trace
                         metrics.stages.observe(
-                            STAGE_QUEUE_DWELL, max(0.0, time.time() - rec.timestamp)
+                            STAGE_QUEUE_DWELL,
+                            max(0.0, time.time() - rec.timestamp),
+                            exemplar=_tr.trace_id
+                            if _tr is not None and _tr.sampled else None,
                         )
                     if not a.quiet:
                         log.info(
@@ -492,6 +503,8 @@ def main(argv=None):
         return 1
     finally:
         heartbeat_done.set()
+        if history is not None:
+            history.stop()
         if heartbeat is not None:
             heartbeat.join(timeout=1.0)
         metrics.attach_queue(None)  # monitor handle is about to die

@@ -17,6 +17,7 @@ from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
     names,
     resend,
     segments,
+    telemetry,
     threads,
     wire,
 )

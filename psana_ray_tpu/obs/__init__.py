@@ -22,6 +22,21 @@ Plus the per-frame layer (ISSUE 4):
 - **Flight recorder** — :mod:`psana_ray_tpu.obs.flight`: bounded event
   ring + dump-on-stall/exception/SIGUSR2 postmortem black box.
 
+And the telemetry plane (ISSUE 13):
+
+- **History** — :mod:`psana_ray_tpu.obs.timeseries`: a bounded,
+  zero-alloc-on-sample ring of periodic registry snapshots per process
+  (rates/percentiles computed at read time; flight dumps append the
+  tail);
+- **Federation** — :mod:`psana_ray_tpu.obs.collector`: one collector
+  pulls every queue server ('N' metrics RPC) and CLI (``/federate``)
+  into a host-tagged series store, with SLO burn-rate alerts;
+- **Console** — ``python -m psana_ray_tpu.obs.top``: the live fleet
+  pane over the federated history (``--once`` for scripts/tests);
+- **Exemplars** — latency histograms retain a sampled trace id per
+  bucket; ``trace_merge --exemplar <id>`` resolves a bad bucket to the
+  frame's merged cross-host timeline.
+
 Everything here is pure stdlib and importable without JAX.
 """
 
@@ -52,6 +67,15 @@ from psana_ray_tpu.obs.stall import (  # noqa: F401
     StallEvent,
 )
 from psana_ray_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
+from psana_ray_tpu.obs.timeseries import (  # noqa: F401
+    HistorySampler,
+    SeriesRing,
+    TimeSeriesStore,
+    add_history_args,
+    configure_history_from_args,
+    default_history,
+)
+from psana_ray_tpu.obs.collector import ClusterCollector  # noqa: F401
 from psana_ray_tpu.obs.tracing import (  # noqa: F401
     TRACER,
     TraceContext,

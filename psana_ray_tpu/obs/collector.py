@@ -1,0 +1,385 @@
+"""Cluster federation: pull every process's metrics into ONE store.
+
+PR 1 gave each process a ``/metrics`` island; ISSUE 13 makes the fleet
+one pane. A :class:`ClusterCollector` polls a static peer list over the
+EXISTING control surfaces:
+
+- ``host:port`` / ``tcp://host:port`` — a queue server: the 'N' JSON
+  RPC with ``{"op": "metrics"}`` answers its whole registry snapshot
+  host-tagged (:func:`psana_ray_tpu.obs.registry.federation_payload`).
+  A pre-ISSUE-13 server answers the op with ``{"ok": False, ...}`` —
+  the peer is marked **degraded** loudly (breadcrumb + gauge), never
+  silently dropped (the 'Z' old-peer precedent);
+- ``http://host:port`` — a producer/consumer/sfx CLI's
+  ``--metrics_port`` endpoint: ``GET /federate`` (same payload), with a
+  ``/healthz`` fallback for peers predating the route (degraded: the
+  snapshot still merges, host-tagged only by its address).
+
+Each successful pull lands in a per-peer
+:class:`~psana_ray_tpu.obs.timeseries.TimeSeriesStore` — the federated,
+host-tagged series history that ``python -m psana_ray_tpu.obs.top``
+renders and ROADMAP item 3's controller will read.
+
+After every sweep the collector evaluates SLO alert rules over the
+merged history (gateway error-budget burn rate, replication lag, stall
+episodes). Alerts are EDGE-TRIGGERED flight-recorder breadcrumbs plus a
+``degraded``-style active-alert gauge on the collector's own registry
+source — firing is loud once, the gauge stays up for the episode.
+
+Pure stdlib (urllib for the HTTP peers), importable without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.timeseries import DEFAULT_CAPACITY, TimeSeriesStore
+
+__all__ = ["ClusterCollector", "PeerState", "parse_peer"]
+
+# peer states (the collector's own gauge vocabulary)
+PEER_UP = "up"
+PEER_DEGRADED = "degraded"  # reachable but pre-federation (old peer)
+PEER_DOWN = "down"
+
+# alert kinds
+ALERT_SLO_BURN = "slo_burn"
+ALERT_REPLICATION_LAG = "replication_lag"
+ALERT_STALL = "stall"
+
+# error-budget burn-rate arithmetic (Google SRE workbook shape): over
+# the short window, burn = (1 - measured attainment) / (1 - SLO
+# target). Burning at 1.0 spends exactly the budget; the default
+# threshold 2.0 = "at this rate the monthly budget is gone in half a
+# month" — early, but the gateway's shed-don't-degrade design means a
+# sustained burn is a real capacity signal, not noise.
+DEFAULT_SLO_TARGET = 0.99
+DEFAULT_BURN_THRESHOLD = 2.0
+DEFAULT_BURN_WINDOW_S = 60.0
+DEFAULT_REPL_LAG_RECORDS = 1000
+
+
+def parse_peer(spec: str) -> Tuple[str, str]:
+    """``spec`` -> (kind, address): ``tcp`` for ``host:port`` /
+    ``tcp://host:port`` (queue server 'N' RPC), ``http`` for
+    ``http://host:port`` (CLI metrics endpoint)."""
+    spec = spec.strip()
+    if not spec:
+        raise ValueError("empty peer spec")
+    if spec.startswith("http://") or spec.startswith("https://"):
+        return "http", spec.rstrip("/")
+    if spec.startswith("tcp://"):
+        spec = spec[len("tcp://"):]
+    host, sep, port = spec.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"peer spec {spec!r} is not host:port / tcp://host:port / "
+            f"http://host:port"
+        )
+    return "tcp", f"{host}:{port}"
+
+
+class _Peer:
+    """One federated peer: its pull transport + series store + state."""
+
+    def __init__(self, spec: str, capacity: int):
+        self.kind, self.address = parse_peer(spec)
+        self.label = self.address if self.kind == "tcp" else spec.rstrip("/")
+        self.store = TimeSeriesStore(capacity)
+        self.state = PEER_DOWN  # until the first successful pull
+        self.host = ""
+        self.pid = 0
+        self.last_pull_wall = 0.0
+        self.last_error = ""
+        self.pulls_ok = 0
+        self.pulls_failed = 0
+        self._client = None  # persistent TCP control connection
+
+    # -- pull transports ---------------------------------------------------
+    def _pull_tcp(self, timeout_s: float) -> dict:
+        from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+        if self._client is None:
+            host, _, port = self.address.rpartition(":")
+            # fail-fast dial: the collector must mark a dead peer DOWN
+            # within one sweep, not ride the reconnect envelope
+            self._client = TcpQueueClient(
+                host, int(port), timeout_s=timeout_s, reconnect_tries=0
+            )
+        return self._client.cluster_rpc({"op": "metrics"})
+
+    def _pull_http(self, timeout_s: float) -> dict:
+        try:
+            with urllib.request.urlopen(
+                f"{self.address}/federate", timeout=timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+        # old peer: no /federate route — merge its /healthz snapshot,
+        # host-tagged only by address (caller marks the peer degraded)
+        with urllib.request.urlopen(
+            f"{self.address}/healthz", timeout=timeout_s
+        ) as resp:
+            return {"ok": True, "_healthz_fallback": True,
+                    "metrics": json.loads(resp.read().decode())}
+
+    def drop_client(self) -> None:
+        client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.disconnect()
+            except Exception:  # noqa: BLE001 — already failing
+                pass
+
+    def pull(self, timeout_s: float) -> dict:
+        if self.kind == "tcp":
+            return self._pull_tcp(timeout_s)
+        return self._pull_http(timeout_s)
+
+
+class PeerState:
+    """Read-model row for one peer (what the console renders)."""
+
+    __slots__ = (
+        "label", "kind", "state", "host", "pid", "age_s", "error",
+    )
+
+    def __init__(self, peer: _Peer, now: float):
+        self.label = peer.label
+        self.kind = peer.kind
+        self.state = peer.state
+        self.host = peer.host
+        self.pid = peer.pid
+        self.age_s = (now - peer.last_pull_wall) if peer.last_pull_wall else -1.0
+        self.error = peer.last_error
+
+
+class ClusterCollector:
+    """Poll the peer list; merge into host-tagged series; alert on SLO
+    burn. ``poll_once`` is separated from the thread loop so tests (and
+    ``obs.top --once``) drive sweeps explicitly."""
+
+    def __init__(
+        self,
+        peers: List[str],
+        interval_s: float = 2.0,
+        capacity: int = DEFAULT_CAPACITY,
+        pull_timeout_s: float = 5.0,
+        slo_target: float = DEFAULT_SLO_TARGET,
+        burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+        burn_window_s: float = DEFAULT_BURN_WINDOW_S,
+        repl_lag_records: int = DEFAULT_REPL_LAG_RECORDS,
+        register: bool = True,
+    ):
+        if not peers:
+            raise ValueError("collector needs at least one peer")
+        self.interval_s = float(interval_s)
+        self.pull_timeout_s = float(pull_timeout_s)
+        self.slo_target = float(slo_target)
+        self.burn_threshold = float(burn_threshold)
+        self.burn_window_s = float(burn_window_s)
+        self.repl_lag_records = int(repl_lag_records)
+        self._lock = threading.Lock()
+        self._peers: Dict[str, _Peer] = {}  # guarded-by: _lock
+        for spec in peers:
+            p = _Peer(spec, capacity)
+            self._peers[p.label] = p
+        self._sweeps = 0  # guarded-by: _lock
+        self._alerts_fired = 0  # guarded-by: _lock
+        self._active_alerts: Dict[Tuple[str, str], float] = {}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if register:
+            try:
+                from psana_ray_tpu.obs.registry import MetricsRegistry
+
+                MetricsRegistry.default().register("collector", self)
+            except Exception:  # noqa: BLE001 — obs optional
+                pass
+
+    # -- one sweep ---------------------------------------------------------
+    def poll_once(self, now: Optional[float] = None) -> Dict[str, str]:
+        """Pull every peer once; returns ``{peer_label: state}``. Peer
+        transitions (up -> down, up -> degraded) leave breadcrumbs —
+        degrade loudly, never die: one dead peer must not blind the
+        pane."""
+        now = time.time() if now is None else now
+        with self._lock:
+            peers = list(self._peers.values())
+        states: Dict[str, str] = {}
+        for peer in peers:
+            prev = peer.state
+            try:
+                payload = peer.pull(self.pull_timeout_s)
+            except Exception as e:  # noqa: BLE001 — a dead peer is DATA
+                peer.drop_client()
+                peer.state = PEER_DOWN
+                peer.last_error = repr(e)
+                peer.pulls_failed += 1
+            else:
+                if payload.get("ok"):
+                    metrics = payload.get("metrics")
+                    peer.store.record(
+                        metrics if isinstance(metrics, dict) else {}, now=now
+                    )
+                    peer.host = payload.get("host", peer.host) or peer.host
+                    peer.pid = int(payload.get("pid", peer.pid) or 0)
+                    peer.last_pull_wall = now
+                    peer.last_error = ""
+                    peer.pulls_ok += 1
+                    peer.state = (
+                        PEER_DEGRADED
+                        if payload.get("_healthz_fallback")
+                        else PEER_UP
+                    )
+                else:
+                    # an old queue server: 'N' answered, but not the
+                    # metrics op — reachable yet pre-federation
+                    peer.state = PEER_DEGRADED
+                    peer.last_error = str(payload.get("error", "refused"))
+                    peer.pulls_failed += 1
+            if peer.state != prev and peer.state != PEER_UP:
+                FLIGHT.record(
+                    "collector_peer_" + peer.state,
+                    peer=peer.label, error=peer.last_error,
+                )
+            states[peer.label] = peer.state
+        with self._lock:
+            self._sweeps += 1
+        self._evaluate_alerts(now, peers)
+        return states
+
+    # -- SLO burn-rate alerts ---------------------------------------------
+    def _burn_rate(self, store: TimeSeriesStore) -> Optional[float]:
+        """Error-budget burn over the short window from the gateway's
+        goodput/completed counters (None without gateway activity)."""
+        good = store.delta("gateway.goodput_total", self.burn_window_s)
+        done = store.delta("gateway.completed_total", self.burn_window_s)
+        if good is None or done is None or done <= 0:
+            return None
+        attainment = good / done
+        budget = max(1e-6, 1.0 - self.slo_target)
+        return (1.0 - attainment) / budget
+
+    def _evaluate_alerts(self, now: float, peers: List[_Peer]) -> None:
+        for peer in peers:
+            store = peer.store
+            burn = self._burn_rate(store)
+            self._set_alert(
+                peer.label, ALERT_SLO_BURN,
+                burn is not None and burn >= self.burn_threshold,
+                now, value=round(burn, 2) if burn is not None else None,
+            )
+            lag = store.last("replication.lag_records")
+            self._set_alert(
+                peer.label, ALERT_REPLICATION_LAG,
+                lag is not None and lag >= self.repl_lag_records,
+                now, value=lag,
+            )
+            stalled = store.last("stalls.degraded")
+            self._set_alert(
+                peer.label, ALERT_STALL, bool(stalled), now, value=stalled
+            )
+
+    def _set_alert(
+        self, peer: str, kind: str, firing: bool, now: float, value=None
+    ) -> None:
+        key = (peer, kind)
+        with self._lock:
+            active = key in self._active_alerts
+            if firing and not active:
+                self._active_alerts[key] = now
+                self._alerts_fired += 1
+            elif not firing and active:
+                del self._active_alerts[key]
+            else:
+                return
+        if firing:  # edge: one breadcrumb per episode, like the stall detector
+            FLIGHT.record("slo_alert", alert=kind, peer=peer, value=value)
+        else:
+            FLIGHT.record("slo_alert_cleared", alert=kind, peer=peer)
+
+    # -- reads (console / controller / tests) ------------------------------
+    def peers(self) -> List[PeerState]:
+        now = time.time()
+        with self._lock:
+            return [PeerState(p, now) for p in self._peers.values()]
+
+    def store(self, label: str) -> Optional[TimeSeriesStore]:
+        with self._lock:
+            p = self._peers.get(label)
+            return p.store if p is not None else None
+
+    def stores(self) -> Dict[str, TimeSeriesStore]:
+        with self._lock:
+            return {label: p.store for label, p in self._peers.items()}
+
+    def active_alerts(self) -> List[dict]:
+        now = time.time()
+        with self._lock:
+            return [
+                {"peer": peer, "alert": kind, "for_s": round(now - since, 1)}
+                for (peer, kind), since in sorted(self._active_alerts.items())
+            ]
+
+    # -- background loop ---------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the pane must outlive a bad sweep
+                pass
+
+    def start(self) -> "ClusterCollector":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="cluster-collector"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        with self._lock:
+            peers = list(self._peers.values())
+        for p in peers:
+            p.drop_client()
+
+    def __enter__(self) -> "ClusterCollector":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- registry source ---------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            peers = list(self._peers.values())
+            sweeps = self._sweeps
+            fired = self._alerts_fired
+            active = len(self._active_alerts)
+        up = sum(1 for p in peers if p.state == PEER_UP)
+        degraded = sum(1 for p in peers if p.state == PEER_DEGRADED)
+        down = sum(1 for p in peers if p.state == PEER_DOWN)
+        return {
+            "peers": len(peers),
+            "peers_up": up,
+            "peers_degraded": degraded,
+            "peers_down": down,
+            "sweeps_total": sweeps,
+            "alerts_fired_total": fired,
+            "alerts_active": active,
+            "pulls_ok_total": sum(p.pulls_ok for p in peers),
+            "pulls_failed_total": sum(p.pulls_failed for p in peers),
+        }

@@ -6,7 +6,12 @@ daemon thread serving:
 
 - ``GET /metrics``  — Prometheus exposition text-format 0.0.4 (scrape me);
 - ``GET /healthz``  — the same registry as a JSON snapshot (humans, tests,
-  and the bench artifact use this shape).
+  and the bench artifact use this shape);
+- ``GET /federate`` — the snapshot wrapped host-tagged (host/pid/wall/
+  mono), byte-compatible with the queue server's 'N' ``{"op":
+  "metrics"}`` RPC answer — what the ISSUE 13 cluster collector pulls
+  from producer/consumer processes (it falls back to ``/healthz`` on
+  peers predating the route).
 
 ``--metrics_port 0`` (the default) starts nothing — the disabled path
 costs literally zero (no socket, no thread). Tests construct
@@ -58,6 +63,11 @@ class MetricsServer:
                         self._send(200, CONTENT_TYPE_PROM, body)
                     elif path in ("/healthz", "/snapshot"):
                         body = json.dumps(reg.snapshot()).encode()
+                        self._send(200, "application/json", body)
+                    elif path == "/federate":
+                        from psana_ray_tpu.obs.registry import federation_payload
+
+                        body = json.dumps(federation_payload(reg)).encode()
                         self._send(200, "application/json", body)
                     else:
                         self._send(404, "text/plain", b"not found\n")

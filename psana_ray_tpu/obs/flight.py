@@ -44,6 +44,12 @@ __all__ = ["FlightRecorder", "FLIGHT"]
 # window keeps the postmortem readable and the disk bounded.
 DUMP_MIN_INTERVAL_S = 5.0
 
+# Samples per key of time-series history appended to a dump (ISSUE 13):
+# at the default 1 s sampling this is the last ~minute of every series
+# BEFORE the trigger — the "how did we get here", where the metrics
+# snapshot is only the "where we ended up".
+TAIL_SAMPLES = 64
+
 
 def _thread_stacks() -> Dict[str, list]:
     """Every live thread's current stack, keyed ``name-ident`` — the
@@ -276,6 +282,18 @@ class FlightRecorder:
                 metrics = MetricsRegistry.default().snapshot()
             except Exception as e:  # noqa: BLE001 — snapshot is best-effort
                 metrics = {"error": repr(e)}
+            # the local time-series tail (ISSUE 13): the minutes BEFORE
+            # the trigger, when a history sampler is running — absent
+            # history costs nothing and fails nothing
+            tail = None
+            try:
+                from psana_ray_tpu.obs.timeseries import default_history
+
+                hist = default_history()
+                if hist is not None:
+                    tail = hist.tail(TAIL_SAMPLES)
+            except Exception as e:  # noqa: BLE001 — best-effort like metrics
+                tail = {"error": repr(e)}
             doc = {
                 "reason": reason,
                 "trigger": trigger,
@@ -287,6 +305,7 @@ class FlightRecorder:
                 "event_counts": counts,
                 "events": events,
                 "metrics": metrics,
+                "timeseries_tail": tail,
                 "threads": _thread_stacks(),
             }
             if path is None:

@@ -21,8 +21,11 @@ Names ending in ``_total`` are typed ``counter``; everything else is a
 from __future__ import annotations
 
 import math
+import os
 import re
+import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from psana_ray_tpu.utils.metrics import LatencyStats, Meter, PipelineMetrics, StageTimes
@@ -41,6 +44,35 @@ def _sanitize(name: str) -> str:
 
 def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def flatten_numeric(
+    path: Tuple[str, ...], value: Any, out: List[Tuple[str, float]]
+) -> None:
+    """Flatten a snapshot tree's numeric leaves into ``(dotted.path,
+    float)`` pairs — ONE flattening grammar shared by the Prometheus
+    renderer and the time-series history ring
+    (:mod:`psana_ray_tpu.obs.timeseries`), so the history key for a
+    metric is its /metrics name with ``.`` for the sanitized ``_``
+    joins. Bools become 0/1; non-finite and non-numeric leaves are
+    skipped. The ``exemplars`` subtree of a latency snapshot is skipped
+    WHOLE: an exemplar is a retained (trace id, value) LINK for the
+    drill-down tooling, not a series — flattening its numeric half
+    would mint a bogus mostly-static gauge per bucket on /metrics and
+    a history ring per bucket in every sampling process."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if k == "exemplars":
+                continue
+            flatten_numeric(path + (str(k),), v, out)
+        return
+    if isinstance(value, bool):
+        out.append((".".join(path), 1.0 if value else 0.0))
+        return
+    if isinstance(value, (int, float)):
+        v = float(value)
+        if math.isfinite(v):
+            out.append((".".join(path), v))
 
 
 def snapshot_source(src: Source) -> dict:
@@ -119,21 +151,6 @@ class MetricsRegistry:
         return out
 
     # -- Prometheus text format ------------------------------------------
-    def _flatten(
-        self, path: Tuple[str, ...], value: Any, out: List[Tuple[str, float]]
-    ):
-        if isinstance(value, dict):
-            for k, v in value.items():
-                self._flatten(path + (str(k),), v, out)
-            return
-        if isinstance(value, bool):
-            out.append(("_".join(path), 1.0 if value else 0.0))
-            return
-        if isinstance(value, (int, float)):
-            v = float(value)
-            if math.isfinite(v):
-                out.append(("_".join(path), v))
-
     def render_prometheus(self) -> str:
         """Exposition text-format 0.0.4: numeric leaves of the snapshot
         tree, grouped per metric family with HELP/TYPE headers, the source
@@ -142,7 +159,7 @@ class MetricsRegistry:
         families: Dict[str, List[Tuple[str, float]]] = {}
         for source, tree in self.snapshot().items():
             leaves: List[Tuple[str, float]] = []
-            self._flatten((), tree, leaves)
+            flatten_numeric((), tree, leaves)
             for path, value in leaves:
                 metric = f"{self.prefix}_{_sanitize(path)}"
                 families.setdefault(metric, []).append((source, value))
@@ -161,3 +178,20 @@ def _format_value(v: float) -> str:
     if v == int(v) and abs(v) < 2**53:
         return str(int(v))
     return repr(v)
+
+
+def federation_payload(registry: Optional[MetricsRegistry] = None) -> dict:
+    """One host-tagged registry snapshot — the federation unit of ISSUE
+    13, served identically by the queue server's 'N' ``{"op":
+    "metrics"}`` RPC and the HTTP exporter's ``/federate`` route, so the
+    collector merges queue servers and producer/consumer CLIs into the
+    same host-tagged series store."""
+    reg = registry if registry is not None else MetricsRegistry.default()
+    return {
+        "ok": True,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "wall": time.time(),
+        "mono": time.monotonic(),
+        "metrics": reg.snapshot(),
+    }

@@ -39,6 +39,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from psana_ray_tpu.obs.tracing import TRACE_KEY
 from psana_ray_tpu.utils.metrics import StageTimes  # noqa: F401  (re-export)
 
 # Hop (boundary) names, in pipeline order.
@@ -78,7 +79,13 @@ def observe_record_stages(
     """Fold one record's hop stamps + the step-completion time into the
     per-stage histograms. Missing boundaries are skipped; the stage ending
     at the next present boundary absorbs the gap, so the observed stages
-    always telescope to (last boundary - first boundary)."""
+    always telescope to (last boundary - first boundary).
+
+    A traced record (its hops dict carries the sampled trace id under
+    ``obs.tracing.TRACE_KEY``) stamps that id as the stage histograms'
+    exemplar — the retained "which frame is in the bad bucket" link that
+    ``trace_merge --exemplar`` resolves (ISSUE 13)."""
+    exemplar = hops.get(TRACE_KEY)  # the sampled trace id, when traced
     prev: Optional[float] = None
     for i, hop in enumerate(HOPS):
         t = hops.get(hop)
@@ -87,13 +94,13 @@ def observe_record_stages(
         if prev is not None:
             # STAGES[i-1] is the stage ENDING at this boundary; when an
             # earlier boundary was missing it absorbs the gap (telescoping)
-            stages.observe(STAGES[i - 1], t - prev)
+            stages.observe(STAGES[i - 1], t - prev, exemplar=exemplar)
         prev = t
     if prev is not None:
-        stages.observe(STAGE_DISPATCH, t_end - prev)
+        stages.observe(STAGE_DISPATCH, t_end - prev, exemplar=exemplar)
         t0 = hops.get(HOP_SRC)
         if t0 is not None:
-            stages.observe(STAGE_E2E, t_end - t0)
+            stages.observe(STAGE_E2E, t_end - t0, exemplar=exemplar)
 
 
 def observe_batch_stages(stages: StageTimes, batch, t_end: Optional[float] = None) -> None:

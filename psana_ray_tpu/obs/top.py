@@ -1,0 +1,10 @@
+"""``python -m psana_ray_tpu.obs.top`` — the live federated console.
+
+Thin entry point; the implementation (collector wiring + ANSI
+rendering) lives in :mod:`psana_ray_tpu.obs.console`.
+"""
+
+from psana_ray_tpu.obs.console import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,13 +122,25 @@ def _expand(paths: List[str]) -> List[str]:
     return out
 
 
-def merge(paths: List[str]) -> dict:
+def merge(paths: List[str], only_trace: Optional[int] = None) -> dict:
     """Merge spool files (or directories of them) into a Chrome
-    trace-event document (the ``json.dump``-ready dict)."""
+    trace-event document (the ``json.dump``-ready dict).
+
+    ``only_trace`` filters to ONE trace id — the ``--exemplar`` lookup
+    (ISSUE 13): a latency-histogram bucket's retained exemplar resolves
+    to just that frame's cross-host timeline."""
     files = _expand(paths)
     if not files:
         raise FileNotFoundError(f"no trace spools found under {paths!r}")
     spools = [load_spool(p) for p in files]
+    if only_trace is not None:
+        for spool in spools:
+            spool["spans"] = [
+                s for s in spool["spans"] if s.get("id") == only_trace
+            ]
+            spool["instants"] = [
+                i for i in spool["instants"] if i.get("id") == only_trace
+            ]
     events: List[dict] = []
     flows: Dict[int, List[dict]] = {}  # trace_id -> [(ts, pid)] span starts
     summary = []
@@ -206,6 +218,28 @@ def merge(paths: List[str]) -> dict:
     }
 
 
+def exemplar_timeline(doc: dict) -> List[dict]:
+    """The filtered merged doc's frame spans in unified-time order —
+    one row per (process, span) with aligned start/duration, the
+    human-readable half of ``--exemplar``."""
+    tracks = {
+        t["track"]: t["process"] for t in doc["otherData"]["tracks"]
+    }
+    rows = []
+    for e in doc["traceEvents"]:
+        if e.get("ph") in ("X", "i") and e.get("cat") == "frame":
+            rows.append(
+                {
+                    "process": tracks.get(e["pid"], str(e["pid"])),
+                    "span": e["name"],
+                    "ts_us": e["ts"],
+                    "dur_us": e.get("dur", 0.0),
+                }
+            )
+    rows.sort(key=lambda r: r["ts_us"])
+    return rows
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m psana_ray_tpu.obs.trace_merge",
@@ -217,12 +251,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="spool files (*.trace.jsonl) or directories containing them",
     )
     p.add_argument("--out", default="merged_trace.json", help="output path")
+    p.add_argument(
+        "--exemplar", default=None, metavar="TRACE_ID",
+        help="resolve ONE trace id (hex 0x... or decimal — the form a "
+        "latency histogram's exemplars dict retains) to its merged "
+        "cross-host timeline: prints the span table and writes the "
+        "filtered trace doc to --out (ISSUE 13)",
+    )
     a = p.parse_args(argv)
+    only_trace = None
+    if a.exemplar is not None:
+        try:
+            only_trace = int(a.exemplar, 0)
+        except ValueError:
+            print(f"error: --exemplar {a.exemplar!r} is not a trace id "
+                  f"(want 0x... or decimal)", file=sys.stderr)
+            return 2
     try:
-        doc = merge(a.inputs)
+        doc = merge(a.inputs, only_trace=only_trace)
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    if only_trace is not None:
+        rows = exemplar_timeline(doc)
+        if not rows:
+            print(
+                f"exemplar {only_trace:#x}: no spans in the given spools "
+                f"(sampled out, or the wrong spool directory)",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"exemplar {only_trace:#x}: {len(rows)} span(s) across "
+              f"{len({r['process'] for r in rows})} process(es)")
+        t0 = rows[0]["ts_us"]
+        for r in rows:
+            print(
+                f"  +{(r['ts_us'] - t0) / 1e3:9.3f}ms "
+                f"{r['span']:<12} {r['dur_us'] / 1e3:9.3f}ms  "
+                f"[{r['process']}]"
+            )
     with open(a.out, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     tracks = doc["otherData"]["tracks"]

@@ -394,11 +394,12 @@ def parse_arguments(argv=None):
     p.add_argument("--num_consumers", type=int, default=1)
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--log_level", default="INFO")
-    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
     from psana_ray_tpu.transport.addressing import add_cluster_args, add_wire_args
 
     add_metrics_args(p)
     add_trace_args(p)
+    add_history_args(p)
     add_cluster_args(p)
     add_wire_args(p, producer=True)
     p.add_argument("--num_shards", type=int, default=1, help="local ingest workers")
@@ -517,6 +518,11 @@ def main(argv=None):
 
     MetricsRegistry.default().register("producer", runtime.metrics)
     metrics_server = start_metrics_server(args.metrics_port, host=args.metrics_host)
+    # history ring (ISSUE 13): feeds flight-dump tails + the /federate
+    # endpoint's consumers; one daemon thread, --history_interval 0 = off
+    from psana_ray_tpu.obs import configure_history_from_args
+
+    history = configure_history_from_args(args)
     monitor = None
     if metrics_server is not None and str(config.transport.address).startswith(
         ("tcp://", "cluster://")
@@ -547,6 +553,8 @@ def main(argv=None):
             exchange_anchors(runtime._queue)
         runtime.run(block=True)
     finally:
+        if history is not None:
+            history.stop()
         if metrics_server is not None:
             metrics_server.close()
         if monitor is not None and hasattr(monitor, "disconnect"):

@@ -152,10 +152,11 @@ def main(argv=None):
             "empty or this many seconds pass, THEN closes (0 = abrupt)"
         ),
     )
-    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
 
     add_metrics_args(p)
     add_trace_args(p)
+    add_history_args(p)
     p.add_argument(
         "--stall_poll_s", type=float, default=1.0,
         help="queue-health poll interval for the stall detector "
@@ -315,6 +316,14 @@ def main(argv=None):
     # steady state is visible on the same endpoint.
     MetricsRegistry.default().register("queue_server", server.stats_all)
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    # Time-series history (ISSUE 13): the bounded per-key snapshot ring
+    # behind flight-dump tails and the federation collector's 'N'
+    # metrics RPC (this server answers it regardless; the sampler adds
+    # the local HISTORY dimension). One daemon thread, preallocated
+    # rings, --history_interval 0 turns it off.
+    from psana_ray_tpu.obs import configure_history_from_args
+
+    history = configure_history_from_args(a)
     # Tracing (relay spans: queue_dwell/relay per sampled frame) and the
     # flight recorder (dump-on-stall/SIGUSR2/exception — the black box for
     # wedged runs) arm from the shared --trace_dir/--flight_dir flags.
@@ -367,6 +376,8 @@ def main(argv=None):
             )
     if stall is not None:
         stall.stop()
+    if history is not None:
+        history.stop()
     if metrics_server is not None:
         metrics_server.close()
     server.close_all()  # unblock ALL clients with TransportClosed (dead-queue parity)

@@ -71,16 +71,55 @@ def _release(rec) -> None:
         release()
 
 
-class _TenantQ:
-    """One tenant's admitted-frame queue + its WDRR deficit."""
+def _trace_id(rec) -> Optional[int]:
+    """The sampled trace id riding a record's envelope, or None — the
+    exemplar the latency histograms retain per bucket (ISSUE 13)."""
+    ctx = getattr(rec, "trace", None)
+    if ctx is not None and getattr(ctx, "sampled", False):
+        return ctx.trace_id
+    return None
 
-    __slots__ = ("name", "weight", "q", "deficit")
+
+class _TenantQ:
+    """One tenant's admitted-frame queue + its WDRR deficit + the
+    measured arrival-rate window (ISSUE 13: admission predicts from
+    rate + backlog, not backlog alone)."""
+
+    __slots__ = ("name", "weight", "q", "deficit", "arrivals")
+
+    # arrival timestamps kept at most this many (bounds memory under a
+    # flood; the rate window trims by TIME, this trims by count)
+    ARRIVALS_CAP = 4096
 
     def __init__(self, name: str, weight: int):
         self.name = name
         self.weight = max(1, int(weight))
         self.q: deque = deque()  # (deadline, admit_t, rec) in admit order
         self.deficit = 0.0
+        self.arrivals: deque = deque(maxlen=self.ARRIVALS_CAP)  # offer() times
+
+    def note_arrival(self, now: float, window_s: float) -> None:
+        """Record one offer() (admitted OR shed — offered rate is the
+        demand signal) and trim the window."""
+        self.arrivals.append(now)
+        cutoff = now - window_s
+        while self.arrivals and self.arrivals[0] < cutoff:
+            self.arrivals.popleft()
+
+    def rate_active(self, now: float, window_s: float) -> bool:
+        """Did this tenant offer anything within the rate window?"""
+        cutoff = now - window_s
+        while self.arrivals and self.arrivals[0] < cutoff:
+            self.arrivals.popleft()
+        return bool(self.arrivals)
+
+    def offered_fps(self, now: float, window_s: float) -> float:
+        cutoff = now - window_s
+        while self.arrivals and self.arrivals[0] < cutoff:
+            self.arrivals.popleft()
+        if not self.arrivals:
+            return 0.0
+        return len(self.arrivals) / window_s
 
 
 class ServingGateway:
@@ -103,12 +142,18 @@ class ServingGateway:
         default_weight: int = 1,
         telemetry: Optional[GatewayTelemetry] = None,
         clock: Callable[[], float] = time.monotonic,
+        rate_window_s: float = 2.0,
     ):
         self._dispatch = dispatch
         self.policy = policy or SloPolicy()
         self._weights = dict(weights or {})
         self._default_weight = max(1, int(default_weight))
         self._clock = clock
+        # admission rate window (ISSUE 13): a tenant that offered within
+        # this window counts toward the predicted WDRR interleave even
+        # while its queue is momentarily empty; 0 restores the PR 12
+        # backlog-only prediction
+        self._rate_window_s = max(0.0, float(rate_window_s))
         self._lock = threading.Lock()
         # serializes dispatch_once end to end: the dispatch callable is
         # NOT required to be thread-safe (make_batch_dispatch's
@@ -143,6 +188,19 @@ class ServingGateway:
         with self._lock:
             return self._backlog
 
+    def offered_fps_by_tenant(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Measured per-tenant offered rate over the admission rate
+        window — the series ISSUE 13's history sampler records (and the
+        admission predictor consumes); empty when rate tracking is off."""
+        if self._rate_window_s <= 0.0:
+            return {}
+        now = self._clock() if now is None else now
+        with self._lock:
+            return {
+                name: round(tq.offered_fps(now, self._rate_window_s), 3)
+                for name, tq in self._tenants.items()
+            }
+
     @property
     def degraded(self) -> bool:
         with self._lock:
@@ -169,27 +227,26 @@ class ServingGateway:
             FLIGHT.record("gateway_restored")
 
     # -- admission (the front door) ---------------------------------------
-    def _predicted_sojourn_ms(self, tq: _TenantQ) -> float:
-        """Queue wait + device time a frame admitted NOW would see: the
-        frame completes when its BATCH completes, so the estimate is
-        batch-quantized — ceil(position / B) batches of this tenant's
-        work, each costing the B8 operating point, interleaved with the
-        other tenants' batches per the WDRR weight share (a tenant at
-        share s sees the device 1/s as often). Under load the
-        dispatcher runs at B8; idle backlogs are one short batch and
-        the estimate stays well under any sane budget."""
+    def _predicted_sojourn_ms(self, tq: _TenantQ, now: float) -> float:
+        """Queue wait + device time a frame admitted NOW would see —
+        :meth:`SloPolicy.predict_sojourn_ms` over the ACTIVE weight
+        total: a tenant counts toward the predicted WDRR interleave
+        when it is backlogged OR its measured offered-rate window is
+        hot (ISSUE 13 — a burster whose queue just drained still takes
+        its turns during this frame's wait; the PR 12 backlog-only
+        share under-predicted by exactly that tenant's slice, and the
+        tail admissions landed late)."""
         # guarded-by-caller: _lock
-        b = self.policy.max_batch
-        svc = self.policy.service_ms(b)
-        total_w = 0
+        total_w = tq.weight
         for other in self._tenants.values():
-            if other.q:
+            if other is tq:
+                continue
+            if other.q or (
+                self._rate_window_s > 0.0
+                and other.rate_active(now, self._rate_window_s)
+            ):
                 total_w += other.weight
-        if not tq.q:
-            total_w += tq.weight
-        share = tq.weight / total_w
-        batches_ahead = (len(tq.q) + 1 + b - 1) // b
-        return batches_ahead * svc / share
+        return self.policy.predict_sojourn_ms(len(tq.q), tq.weight, total_w)
 
     def offer(
         self,
@@ -207,10 +264,16 @@ class ServingGateway:
         now = self._clock() if now is None else now
         with self._lock:
             tq = self._tenant(tenant, weight)
+            if self._rate_window_s > 0.0:
+                # the offer itself is the arrival signal (admitted or
+                # shed — offered rate measures DEMAND), recorded before
+                # the prediction so a tenant's own burst is visible to
+                # every same-instant competitor
+                tq.note_arrival(now, self._rate_window_s)
             if deadline is None:
                 deadline = now + self.policy.slo_ms / 1000.0
             remain_ms = (deadline - now) * 1000.0
-            predicted = self._predicted_sojourn_ms(tq)
+            predicted = self._predicted_sojourn_ms(tq, now)
             path = None
             if predicted > min(self.policy.budget_ms(self._degraded), remain_ms):
                 # the stall path: this frame would have been admitted at
@@ -319,14 +382,18 @@ class ServingGateway:
         if not batch:
             return len(shed_recs)
         recs = [rec for (_d, _t, rec) in batch]
+        # exemplar capture BEFORE dispatch consumes the leases: a
+        # sampled record's trace id tags the latency observation so a
+        # bad bucket resolves to that frame's cross-host timeline
+        exemplars = [_trace_id(rec) for (_d, _t, rec) in batch]
         t0 = self._clock()
         self._dispatch(recs, batch_size)
         t1 = self._clock()
         self.policy.observe_service(batch_size, (t1 - t0) * 1000.0)
         self.telemetry.dispatched(batch_size, len(recs))
-        for deadline, admit_t, _rec in batch:
+        for (deadline, admit_t, _rec), tid in zip(batch, exemplars):
             self.telemetry.completed(
-                tenant, t1 - admit_t, in_slo=(t1 <= deadline)
+                tenant, t1 - admit_t, in_slo=(t1 <= deadline), exemplar=tid
             )
         return len(recs) + len(shed_recs)
 

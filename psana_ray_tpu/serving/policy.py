@@ -138,6 +138,31 @@ class SloPolicy:
             self.degraded_margin if degraded else self.shed_margin
         )
 
+    def predict_sojourn_ms(
+        self, queue_len: int, weight: int, active_weight_total: int
+    ) -> float:
+        """Queue wait + device time a frame admitted NOW would see — the
+        admission estimate (ISSUE 12, refined by ISSUE 13).
+
+        Batch-quantized: the frame completes when its BATCH completes,
+        so it waits ``ceil(position / B)`` dispatches of its own tenant,
+        each costing the max operating point, interleaved per the WDRR
+        share ``weight / active_weight_total``.
+
+        ``active_weight_total`` is where the MEASURED per-tenant arrival
+        rates enter (ISSUE 13): the gateway sums the weights of every
+        tenant that is backlogged OR offering at a live rate — a tenant
+        whose queue happens to be momentarily empty but whose offered-
+        rate series is hot WILL take its WDRR turns during this frame's
+        wait, and the backlog-only estimate (the PR 12 behavior, which
+        counted only currently-backlogged tenants) under-predicted by
+        exactly that tenant's share."""
+        b = self.max_batch
+        svc = self.service_ms(b)
+        share = weight / max(weight, active_weight_total)
+        batches_ahead = (queue_len + 1 + b - 1) // b
+        return batches_ahead * svc / share
+
     def observe_service(self, batch: int, measured_ms: float) -> None:
         """Feed one dispatch's measured wall time back into the table
         (single writer: the gateway dispatch loop)."""

@@ -68,10 +68,12 @@ class GatewayTelemetry:
         self.escalations = 0  # guarded-by: _lock
         self.restores = 0  # guarded-by: _lock
         self._tenants: Dict[str, _TenantStats] = {}  # guarded-by: _lock
-        self._gw = None  # the gateway, for the degraded/backlog gauges
+        # the gateway, for the degraded/backlog gauges
+        self._gw = None  # guarded-by: _lock
 
     def attach(self, gateway) -> None:
-        self._gw = gateway
+        with self._lock:
+            self._gw = gateway
         if not self._register:
             return
         with self._lock:
@@ -118,7 +120,17 @@ class GatewayTelemetry:
                 self.offered_total += n
                 ts.offered += n
 
-    def completed(self, tenant: str, latency_s: float, in_slo: bool) -> None:
+    def completed(
+        self,
+        tenant: str,
+        latency_s: float,
+        in_slo: bool,
+        exemplar: Optional[int] = None,
+    ) -> None:
+        """``exemplar`` (a sampled frame's trace id, ISSUE 13) is
+        retained per latency bucket by the tenant's reservoir — the
+        link ``trace_merge --exemplar`` resolves from a bad p99 bucket
+        to that frame's cross-host timeline."""
         with self._lock:
             self.completed_total += 1
             ts = self._tenant(tenant)
@@ -126,7 +138,7 @@ class GatewayTelemetry:
             if in_slo:
                 self.goodput_total += 1
                 ts.goodput += 1
-        ts.lat.observe(latency_s)  # internally locked
+        ts.lat.observe(latency_s, exemplar=exemplar)  # internally locked
 
     def dispatched(self, batch: int, n_frames: int) -> None:
         with self._lock:
@@ -152,8 +164,8 @@ class GatewayTelemetry:
             return {t: ts.goodput for t, ts in self._tenants.items()}
 
     def stats(self) -> dict:
-        gw = self._gw
         with self._lock:
+            gw = self._gw
             out = {
                 "offered_total": self.offered_total,
                 "admitted_total": self.admitted_total,
@@ -172,13 +184,22 @@ class GatewayTelemetry:
             for p, n in self._shed_by_path.items():
                 out[f"shed_{p}_total"] = n
             tenants = list(self._tenants.items())
+        rates: Dict[str, float] = {}
         if gw is not None:
             out["degraded"] = 1 if gw.degraded else 0
             out["backlog"] = gw.backlog()
+            try:
+                # the per-tenant offered-rate series (ISSUE 13): what
+                # the admission predictor consumes, exported so the
+                # history ring records demand next to goodput
+                rates = gw.offered_fps_by_tenant()
+            except Exception:  # noqa: BLE001 — a mid-teardown gateway
+                rates = {}
         for t, ts in tenants:
             lat = ts.lat.snapshot()
             out[t] = {
                 "offered": ts.offered,
+                "offered_fps": rates.get(t, 0.0),
                 "admitted": ts.admitted,
                 "shed": ts.shed,
                 "completed": ts.completed,
@@ -188,6 +209,9 @@ class GatewayTelemetry:
                 ) if ts.completed else 1.0,
                 "p99_ms": lat.get("p99_ms", 0.0),
             }
+            ex = ts.lat.exemplars()
+            if ex:
+                out[t]["exemplars"] = ex
         return out
 
     # obs registry source protocol

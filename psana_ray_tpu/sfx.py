@@ -434,13 +434,14 @@ def main(argv=None):
         help="allow truncating an existing --output on a FRESH run "
         "(resumed runs — cursor already has positions — always append)",
     )
-    from psana_ray_tpu.obs import add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
     from psana_ray_tpu.transport.addressing import add_cluster_args
 
     add_cluster_args(ap, consumer=True)
 
     add_metrics_args(ap)
     add_trace_args(ap)
+    add_history_args(ap)
     ap.add_argument("--log_level", default="INFO")
     a = ap.parse_args(argv)
     logging.basicConfig(
@@ -563,6 +564,10 @@ def main(argv=None):
     from psana_ray_tpu.obs import MetricsRegistry, start_metrics_server
 
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    # history ring (ISSUE 13): flight-dump tails + /federate consumers
+    from psana_ray_tpu.obs import configure_history_from_args
+
+    history = configure_history_from_args(a)
     # queue depth for scrapes over a DEDICATED handle, never the data
     # connection: over TCP any opcode on the data connection implicitly
     # ACKs its in-flight GET deliveries (transport.tcp serve loop), so a
@@ -619,6 +624,8 @@ def main(argv=None):
         log.error("%s", e)
         return 1
     finally:
+        if history is not None:
+            history.stop()
         if metrics_server is not None:
             metrics_server.close()
         if monitor is not None and hasattr(monitor, "disconnect"):

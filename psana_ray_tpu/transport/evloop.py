@@ -67,6 +67,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.registry import federation_payload as _metrics_rpc_payload
 from psana_ray_tpu.obs.tracing import TRACER
 from psana_ray_tpu.transport.registry import TransportClosed
 from psana_ray_tpu.transport.ring import EMPTY
@@ -1059,7 +1060,16 @@ class _EvConn:
     def _cluster_finish(self) -> None:
         try:
             req = json.loads(self._open_buf.decode())
-            resp = self.srv.groups.handle(req)
+            if req.get("op") == "metrics":
+                # federation pull (ISSUE 13): the whole metrics-registry
+                # snapshot, host-tagged, over the EXISTING control
+                # surface — no new opcode, and a pre-ISSUE-13 peer
+                # answers {"ok": False, "error": "missing group"}, which
+                # the collector surfaces as a loudly-degraded peer (the
+                # 'Z' old-peer precedent)
+                resp = _metrics_rpc_payload()
+            else:
+                resp = self.srv.groups.handle(req)
         except Exception as e:  # noqa: BLE001 — a bad RPC must not kill the loop
             resp = {"ok": False, "error": repr(e)}
         payload = json.dumps(resp).encode()

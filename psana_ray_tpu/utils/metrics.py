@@ -70,12 +70,35 @@ class Meter:
         return {"total": self.count, "per_second": round(self.rate(), 3)}
 
 
+# Exemplar bucket boundaries (ms, upper-inclusive; the last bucket is
+# +inf). Log-scaled like a Prometheus latency histogram: an operator
+# asking "what is IN the bad bucket" gets one retained trace id per
+# bucket (Dapper-style exemplars, ISSUE 13) — `trace_merge --exemplar
+# <id>` resolves it to the frame's cross-host timeline.
+EXEMPLAR_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+    2500.0, float("inf"),
+)
+
+
+def _bucket_of(ms: float) -> int:
+    for i, le in enumerate(EXEMPLAR_BUCKETS_MS):
+        if ms <= le:
+            return i
+    return len(EXEMPLAR_BUCKETS_MS) - 1
+
+
 class LatencyStats:
     """Reservoir-sampled latency quantiles (fixed memory, unbiased).
 
     The sorted view is CACHED and invalidated on ``observe``, so a burst of
     quantile reads (``summary_ms`` used to sort three times per status
     line) pays for at most one sort per new sample.
+
+    ``observe(seconds, exemplar=...)`` additionally retains the LAST
+    exemplar (a trace id) seen per latency bucket
+    (:data:`EXEMPLAR_BUCKETS_MS`) — bounded memory (one slot per
+    bucket), zero cost for callers that never pass one.
     """
 
     def __init__(self, reservoir_size: int = 4096, seed: int = 0):
@@ -86,11 +109,17 @@ class LatencyStats:
         self._samples: List[float] = []
         self._sorted: Optional[List[float]] = None
         self._rng = random.Random(seed)
+        # bucket index -> (exemplar trace id, observed ms)
+        self._exemplars: Dict[int, tuple] = {}  # guarded-by: _lock
 
-    def observe(self, seconds: float):
+    def observe(self, seconds: float, exemplar: Optional[int] = None):
         with self._lock:
             self._n += 1
             self._sum += seconds
+            if exemplar is not None:
+                self._exemplars[_bucket_of(seconds * 1e3)] = (
+                    exemplar, seconds * 1e3,
+                )
             if len(self._samples) < self._size:
                 self._samples.append(seconds)
                 self._sorted = None
@@ -101,6 +130,23 @@ class LatencyStats:
                     # rejected samples (the common case once n >> size)
                     # leave the reservoir untouched — keep the cache hot
                     self._sorted = None
+
+    def exemplars(self) -> Dict[str, Dict[str, float]]:
+        """``{"le_<bound_ms>": {"trace_id": "0x...", "ms": ...}}`` — the
+        retained exemplar per non-empty latency bucket. Trace ids render
+        as hex strings (the form ``trace_merge --exemplar`` accepts);
+        the whole ``exemplars`` subtree is excluded from the numeric
+        flatten (``obs.registry.flatten_numeric``), so exemplars reach
+        /healthz and the drill-down tooling but never mint Prometheus
+        gauges or history rings."""
+        with self._lock:
+            items = list(self._exemplars.items())
+        out: Dict[str, Dict[str, float]] = {}
+        for idx, (tid, ms) in items:
+            le = EXEMPLAR_BUCKETS_MS[idx]
+            label = "le_inf" if le == float("inf") else f"le_{le:g}"
+            out[label] = {"trace_id": f"{int(tid):#x}", "ms": round(ms, 3)}
+        return out
 
     def _sorted_view(self) -> List[float]:
         # guarded-by-caller: _lock
@@ -152,6 +198,9 @@ class LatencyStats:
         out["mean_ms"] = round((total / n) * 1e3, 6)
         for name, q in (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99)):
             out[name] = round(s[min(len(s) - 1, max(0, int(q * len(s))))] * 1e3, 6)
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = ex
         return out
 
 
@@ -170,12 +219,12 @@ class StageTimes:
         self._lock = threading.Lock()
         self._stats: Dict[str, LatencyStats] = {}
 
-    def observe(self, stage: str, seconds: float):
+    def observe(self, stage: str, seconds: float, exemplar: Optional[int] = None):
         st = self._stats.get(stage)
         if st is None:
             with self._lock:
                 st = self._stats.setdefault(stage, LatencyStats())
-        st.observe(seconds)
+        st.observe(seconds, exemplar=exemplar)
 
     def stat(self, stage: str) -> Optional[LatencyStats]:
         with self._lock:

@@ -1,0 +1,37 @@
+"""Known-bad fixture for the telemetry-discipline checker.
+
+Both rules must flag: an obs source whose ``snapshot()`` reads mutable
+counter state outside the class lock (a torn federated scrape), and a
+``# lint: sample-path`` function that allocates per sample.
+"""
+
+import threading
+
+
+class TornSource:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._bytes = 0
+
+    def observe(self, n):
+        with self._lock:
+            self._count += 1
+            self._bytes += n
+
+    def snapshot(self):
+        # BAD: mutable counters read bare — the 1 Hz sampler can record
+        # a count/bytes pair no single instant ever had
+        return {"count_total": self._count, "bytes_total": self._bytes}
+
+
+class AllocatingRing:
+    def __init__(self, capacity):
+        self.rows = [None] * capacity
+        self.i = 0
+
+    def append(self, t, v):  # lint: sample-path
+        # BAD: a fresh list per sample — the sample path must stay
+        # counter arithmetic into preallocated storage
+        self.rows[self.i] = [t, v]
+        self.i = (self.i + 1) % len(self.rows)

@@ -1,0 +1,47 @@
+"""Known-good fixture for the telemetry-discipline checker.
+
+The sanctioned patterns: snapshot() copies mutable state under the
+class lock (or the attribute is `# guarded-by` annotated, handing the
+proof to the lock-discipline checker); the sample path is pure index
+arithmetic into preallocated columns; set-once configuration from
+``__init__`` needs no lock.
+"""
+
+import threading
+
+
+class ConsistentSource:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._bytes = 0
+        self._gw = None  # guarded-by: _lock
+        self.name = "consistent"  # set-once: assigned only here
+
+    def attach(self, gw):
+        with self._lock:
+            self._gw = gw
+
+    def observe(self, n):
+        with self._lock:
+            self._count += 1
+            self._bytes += n
+
+    def snapshot(self):
+        with self._lock:
+            count, nbytes = self._count, self._bytes
+        return {"source": self.name, "count_total": count, "bytes_total": nbytes}
+
+
+class PreallocatedRing:
+    def __init__(self, capacity):
+        self.t = [0.0] * capacity
+        self.v = [0.0] * capacity
+        self.i = 0
+        self.cap = capacity
+
+    def append(self, t, v):  # lint: sample-path
+        i = self.i
+        self.t[i] = t
+        self.v[i] = v
+        self.i = i + 1 if i + 1 < self.cap else 0

@@ -158,3 +158,76 @@ def test_soft_cancel_grace_adapts_to_global_headroom(fresh_final):
         # silently truncate a green pytest run
         for w in watchdogs:
             w._global_deadline = time.monotonic() + 10**9
+
+
+# ---------------------------------------------------------------------------
+# Baseline regression gate (ISSUE 13): synthetic artifact pair
+# ---------------------------------------------------------------------------
+
+def test_compare_baseline_flags_regressed_key_rows():
+    baseline = {
+        "value": 28197.1,
+        "host_passthrough_fps": 100.0,
+        "device_resnet50_fps": 1750.0,
+        "host_datapath_copies_per_frame": 1.0,
+        "host_datapath_allocs_per_frame": 0.0,
+        "serving": {"gateway_p99_ms": 290.0},
+        "wire_compression_best_ratio": 3.19,
+        "replication_kill_lost": 0,
+    }
+    current = dict(baseline)
+    current.update(
+        {
+            "host_passthrough_fps": 70.0,           # -30% fps: regression
+            "device_resnet50_fps": 1745.0,          # -0.3%: within noise
+            "host_datapath_copies_per_frame": 1.5,  # zero-copy pin broken
+            "serving": {"gateway_p99_ms": 500.0},   # p99 blown
+            "wire_compression_best_ratio": 3.1,     # -3%: within noise
+            "replication_kill_lost": 2,             # lost frames: always
+        }
+    )
+    regs = bench.compare_baseline(current, baseline)
+    by_key = {r["key"]: r for r in regs}
+    assert set(by_key) == {
+        "host_passthrough_fps",
+        "host_datapath_copies_per_frame",
+        "serving.gateway_p99_ms",
+        "replication_kill_lost",
+    }
+    assert by_key["host_passthrough_fps"]["rule"] == "fps"
+    assert by_key["host_passthrough_fps"]["change_pct"] == -30.0
+    assert by_key["serving.gateway_p99_ms"]["rule"] == "latency_ms"
+    assert by_key["host_datapath_copies_per_frame"]["rule"] == "copies_per_frame"
+    assert by_key["replication_kill_lost"]["rule"] == "lost_frames"
+
+
+def test_compare_baseline_clean_pair_is_empty():
+    art = {"host_passthrough_fps": 100.0, "value": 5.0,
+           "serving": {"gateway_p99_ms": 290.0}}
+    assert bench.compare_baseline(dict(art), dict(art)) == []
+    # improvements are never regressions
+    better = {"host_passthrough_fps": 140.0, "value": 9.0,
+              "serving": {"gateway_p99_ms": 150.0}}
+    assert bench.compare_baseline(better, art) == []
+
+
+def test_load_baseline_accepts_driver_round_and_full_artifact(tmp_path):
+    rnd = tmp_path / "BENCH_r99.json"
+    rnd.write_text(json.dumps({"n": 99, "parsed": {"value": 1.0}}))
+    assert bench.load_baseline_artifact(str(rnd)) == {"value": 1.0}
+    full = tmp_path / "bench_full.json"
+    full.write_text(json.dumps({"value": 2.0}))
+    assert bench.load_baseline_artifact(str(full)) == {"value": 2.0}
+
+
+def test_apply_baseline_gate_embeds_regressions(fresh_final, tmp_path):
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"host_passthrough_fps": 100.0}))
+    extras = bench._FINAL
+    extras["host_passthrough_fps"] = 50.0
+    bench.apply_baseline_gate(extras, str(base))
+    assert extras["baseline_compared"]["regression_count"] == 1
+    assert extras["regressions"][0]["key"] == "host_passthrough_fps"
+    # the gate is data, never an exception — even on garbage input
+    bench.apply_baseline_gate(extras, str(tmp_path / "missing.json"))
+    assert "baseline_error" in extras

@@ -97,6 +97,38 @@ class TestDumpOnStall:
             for stack in doc["threads"].values()
         )
 
+    def test_dump_appends_timeseries_tail(self, recorder):
+        """ISSUE 13: when a history sampler runs, dumps carry the last N
+        samples per key — the minutes BEFORE the trigger, not just the
+        instant. Without one, the key is present and null (the dump
+        shape is stable either way)."""
+        from psana_ray_tpu.obs import timeseries as ts_mod
+        from psana_ray_tpu.obs.flight import TAIL_SAMPLES
+
+        fl, tmp_path = recorder
+        # no sampler -> tail is null, dump still lands
+        p0 = fl.dump("pretail", force=True)
+        assert json.loads(open(p0).read())["timeseries_tail"] is None
+        reg = MetricsRegistry()
+        reg.register("unit", lambda: {"frames_total": 1})
+        sampler = ts_mod.start_default_history(
+            interval_s=60.0, registry=reg  # manual sweeps only
+        )
+        try:
+            for i in range(TAIL_SAMPLES + 10):  # overfill: tail must bound
+                sampler.sample_once(now=100.0 + i)
+            path = fl.dump("history", force=True)
+            doc = json.loads(open(path).read())
+            tail = doc["timeseries_tail"]
+            assert tail is not None
+            series = tail["unit.frames_total"]
+            assert len(series) == TAIL_SAMPLES  # bounded
+            # time-ordered, ending at the LAST pre-trigger sample
+            assert series[-1][0] == pytest.approx(100.0 + TAIL_SAMPLES + 9)
+            assert series[0][0] < series[-1][0]
+        finally:
+            ts_mod.stop_default_history()
+
     def test_dump_rate_limit(self, recorder):
         fl, tmp_path = recorder
         ev = StallEvent(EVENT_BACKPRESSURE, "q", 1.0, 8, 8)

@@ -649,6 +649,112 @@ class TestEvloopWdrr:
 # open-loop burst generation (tests/faultproxy.py satellite)
 # ---------------------------------------------------------------------------
 
+class TestRateAwareAdmission:
+    """ISSUE 13 satellite (the PR 12 parked follow-up): admission
+    predicts from measured per-tenant arrival RATES + backlog, not
+    backlog alone. A bursty tenant whose queue happens to be drained at
+    the instant a competitor's frame arrives still takes its WDRR turns
+    during that frame's wait — the backlog-only share over-admitted the
+    competitor, and those tail admissions landed late."""
+
+    def _burst_and_drain(self, gw, clock, tenant, n=8, at=None):
+        if at is not None:
+            clock.t = at
+        for i in range(n):
+            assert gw.offer(_rec(i), tenant=tenant)
+        while gw.dispatch_once():
+            pass
+
+    def test_hot_but_drained_tenant_halves_the_predicted_share(self):
+        gw, clock = _gateway(slo_ms=25.0)
+        # B bursts and fully drains: queue empty, offered-rate hot
+        self._burst_and_drain(gw, clock, "B", at=0.0)
+        # A's admissions stop at the HALVED share: predicted sojourn
+        # ceil((k+1)/8) * 4.33ms / 0.5 crosses the 22.5 ms budget at
+        # k=16; backlog-only (window 0) admits well past it
+        a_admitted = sum(gw.offer(_rec(i), tenant="A") for i in range(24))
+        assert a_admitted == 16
+        gw0, clock0 = _gateway(slo_ms=25.0, rate_window_s=0.0)
+        self._burst_and_drain(gw0, clock0, "B", at=0.0)
+        a0_admitted = sum(gw0.offer(_rec(i), tenant="A") for i in range(24))
+        assert a0_admitted == 24  # the PR 12 behavior this satellite fixes
+
+    def test_rate_window_expires(self):
+        gw, clock = _gateway(slo_ms=25.0)
+        self._burst_and_drain(gw, clock, "B", at=0.0)
+        # 3 s later (window 2 s): B's burst no longer predicts
+        clock.t = 3.0
+        a_admitted = sum(gw.offer(_rec(i), tenant="A") for i in range(24))
+        assert a_admitted == 24
+
+    def test_offered_rate_series_exported(self):
+        gw, clock = _gateway(slo_ms=1000.0)
+        clock.t = 1.0
+        for i in range(10):
+            gw.offer(_rec(i), tenant="A")  # admitted or shed both count
+        rates = gw.offered_fps_by_tenant()
+        assert rates["A"] == pytest.approx(10 / 2.0)  # 10 offers / 2 s window
+        stats = gw.telemetry.stats()
+        assert stats["A"]["offered_fps"] == rates["A"]
+
+    def test_ramp_schedule_rate_aware_keeps_admitted_work_in_slo(self):
+        """The pin: drive tenant B with a RAMP arrival schedule
+        (faultproxy.arrival_schedule, time-compressed onto the sim
+        clock) against a steady tenant A. Rate-aware admission keeps
+        every ADMITTED frame inside the SLO across the ramp; the
+        backlog-only predictor admits A frames during B's drained
+        instants whose deadlines then die to B's next burst."""
+
+        def drive(rate_window_s):
+            gw, clock = _gateway(
+                slo_ms=25.0, weights={"A": 1, "B": 3},
+                rate_window_s=rate_window_s,
+            )
+            # B ramps 600 Hz -> 4 kHz over 40 ms: the early ramp DRAINS
+            # between arrivals (B1 service 0.89 ms < the ~5 ms gaps),
+            # the late ramp outruns B8 capacity (~1.85 kfps) and piles
+            # up. A bursts 24 frames at t=5 ms — an instant where B's
+            # queue is empty but its offered-rate window is hot.
+            sched = arrival_schedule("ramp", 600.0, 0.04, ramp_to_hz=4000.0)
+            events = sorted(
+                [(t, "B") for t in sched] + [(0.005, "A")] * 24
+            )
+            a_i, b_i = 0, 0
+            for t, tenant in events:
+                if t > clock.t:
+                    # idle gap: let the device catch up before the next
+                    # arrival (open-loop: arrivals never wait)
+                    while clock.t < t and gw.dispatch_once():
+                        pass
+                    clock.t = max(clock.t, t)
+                idx = a_i if tenant == "A" else b_i
+                gw.offer(_rec(idx), tenant=tenant)
+                if tenant == "A":
+                    a_i += 1
+                else:
+                    b_i += 1
+            while gw.dispatch_once():
+                pass
+            return gw.telemetry.stats()
+
+        rate_aware = drive(rate_window_s=2.0)
+        backlog_only = drive(rate_window_s=0.0)
+        # conservation holds for both (shed is loud, never lost)
+        for s in (rate_aware, backlog_only):
+            assert s["offered_total"] == s["completed_total"] + s["shed_total"]
+        # the satellite's promise: with rate in the predictor, admitted
+        # work completes inside the SLO across the whole ramp...
+        assert rate_aware["goodput_total"] == rate_aware["completed_total"]
+        # ...and over-admission surfaces WHERE the shed happens: the
+        # rate-aware door rejects doomed frames at admission (zero spent
+        # on them), while the backlog-only predictor admits frames whose
+        # deadlines then die to demand it could not see — they are
+        # dropped at DEQUEUE after wasting queue residency (the dequeue
+        # re-check is what keeps them from completing late)
+        assert rate_aware["shed_deadline_total"] == 0
+        assert backlog_only["shed_deadline_total"] > 0
+
+
 class TestArrivalSchedules:
     def test_steady_spacing_and_count(self):
         s = arrival_schedule("steady", 100.0, 2.0)

@@ -1,0 +1,584 @@
+"""ISSUE 13 telemetry plane: history rings, federation, exemplars, console.
+
+Five layers, fast and jax-free:
+
+1. :class:`SeriesRing` / :class:`TimeSeriesStore` — bounded ring
+   semantics, zero-alloc steady-state appends, and the read-time
+   delta/rate/EWMA/percentile views against hand-computed values;
+2. federation — a :class:`ClusterCollector` over LIVE queue servers via
+   the 'N' metrics RPC and a live HTTP ``/federate`` endpoint, with the
+   dead-peer and old-peer (degrade loudly) paths pinned;
+3. SLO burn-rate alerts — edge-triggered breadcrumbs + the active gauge
+   over a deterministic synthetic peer;
+4. exemplars — a latency histogram's retained trace id resolves through
+   ``trace_merge --exemplar`` to the frame's merged timeline, including
+   the gateway-completed path end to end;
+5. ``obs.top --once`` — golden-ish render over a LIVE 3-process
+   queue-server mini-cluster (subprocess CLIs, the acceptance row).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.obs import trace_merge
+from psana_ray_tpu.obs.collector import (
+    ALERT_SLO_BURN,
+    ClusterCollector,
+    PEER_DEGRADED,
+    PEER_DOWN,
+    PEER_UP,
+    parse_peer,
+)
+from psana_ray_tpu.obs.console import main as top_main, render, sparkline
+from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.registry import MetricsRegistry, federation_payload
+from psana_ray_tpu.obs.timeseries import (
+    HistorySampler,
+    SeriesRing,
+    TimeSeriesStore,
+)
+from psana_ray_tpu.obs.tracing import Tracer
+from psana_ray_tpu.transport.ring import RingBuffer
+from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+from psana_ray_tpu.utils.metrics import LatencyStats
+
+
+# ---------------------------------------------------------------------------
+# 1. ring + store semantics
+# ---------------------------------------------------------------------------
+
+class TestSeriesRing:
+    def test_bounded_and_ordered(self):
+        r = SeriesRing(capacity=8)
+        for i in range(30):
+            r.append(float(i), float(i * 10))
+        assert len(r) == 8
+        pts = r.samples()
+        assert [t for t, _ in pts] == [float(i) for i in range(22, 30)]
+        assert pts[-1] == (29.0, 290.0)
+        assert r.last() == (29.0, 290.0)
+        # partial tail
+        assert [v for _, v in r.samples(3)] == [270.0, 280.0, 290.0]
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            SeriesRing(capacity=1)
+
+    def test_append_is_allocation_free_steady_state(self):
+        """The zero-alloc-on-sample contract: appends into a warmed ring
+        allocate nothing (index arithmetic into preallocated arrays)."""
+        r = SeriesRing(capacity=64)
+        for i in range(128):  # warm: wrap at least once
+            r.append(float(i), 1.0)
+        before = sys.getallocatedblocks()
+        for i in range(10_000):
+            r.append(float(i), 2.0)
+        grew = sys.getallocatedblocks() - before
+        assert grew <= 16, f"ring append allocated ({grew} blocks / 10k appends)"
+
+
+class TestTimeSeriesStore:
+    def _filled(self):
+        s = TimeSeriesStore(capacity=16)
+        # a counter climbing 5/s and a sawtooth gauge, 1 Hz for 10 s
+        for i in range(10):
+            s.record(
+                {"src": {"frames_total": i * 5, "depth": float(i % 4)}},
+                now=100.0 + i,
+            )
+        return s
+
+    def test_flatten_and_keys(self):
+        s = self._filled()
+        assert s.keys() == ["src.depth", "src.frames_total"]
+        assert s.last("src.frames_total") == 45.0
+        assert s.last("missing") is None
+
+    def test_delta_rate_windows(self):
+        s = self._filled()
+        assert s.delta("src.frames_total") == 45.0
+        assert s.rate("src.frames_total") == pytest.approx(5.0)
+        # window: only the last ~4 s participate
+        assert s.rate("src.frames_total", window_s=4.0) == pytest.approx(5.0)
+        assert s.delta("src.frames_total", window_s=2.0) == pytest.approx(10.0)
+        assert s.rate("missing") is None
+
+    def test_percentile_and_ewma(self):
+        s = self._filled()
+        # depth cycles 0,1,2,3 — median 1 or 2, p0 = 0, p99 = 3
+        assert s.percentile("src.depth", 0.0) == 0.0
+        assert s.percentile("src.depth", 0.99) == 3.0
+        ewma = s.ewma("src.depth", alpha=1.0)  # alpha 1 = last value
+        assert ewma == s.last("src.depth")
+
+    def test_tail_bounded_and_json_safe(self):
+        s = self._filled()
+        tail = s.tail(3)
+        assert set(tail) == {"src.depth", "src.frames_total"}
+        assert len(tail["src.frames_total"]) == 3
+        json.dumps(tail)  # flight dumps embed this verbatim
+
+    def test_ring_eviction_through_store(self):
+        s = TimeSeriesStore(capacity=4)
+        for i in range(10):
+            s.record({"a": {"v": i}}, now=float(i))
+        assert [v for _, v in s.series("a.v")] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_sampler_sweeps_registry(self):
+        reg = MetricsRegistry()
+        n = {"count_total": 0}
+        reg.register("fake", lambda: dict(n))
+        sampler = HistorySampler(registry=reg, interval_s=1.0, capacity=8)
+        sampler.sample_once(now=1.0)
+        n["count_total"] = 7
+        sampler.sample_once(now=2.0)
+        assert sampler.store.delta("fake.count_total") == 7.0
+        snap = sampler.snapshot()
+        assert snap["sweeps_total"] == 2
+        assert snap["keys"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. federation over live control surfaces
+# ---------------------------------------------------------------------------
+
+def test_parse_peer_specs():
+    assert parse_peer("tcp://h:9") == ("tcp", "h:9")
+    assert parse_peer("h:9") == ("tcp", "h:9")
+    assert parse_peer("http://h:9/") == ("http", "http://h:9")
+    with pytest.raises(ValueError):
+        parse_peer("not-a-peer")
+
+
+class TestFederation:
+    def test_tcp_metrics_rpc_merges_host_tagged(self):
+        srv = TcpQueueServer(RingBuffer(10), host="127.0.0.1").serve_background()
+        srv2 = TcpQueueServer(RingBuffer(10), host="127.0.0.1").serve_background()
+        c = ClusterCollector(
+            [f"127.0.0.1:{srv.port}", f"127.0.0.1:{srv2.port}"],
+            register=False,
+        )
+        try:
+            states = c.poll_once()
+            assert set(states.values()) == {PEER_UP}
+            peers = c.peers()
+            assert len(peers) == 2
+            for p in peers:
+                assert p.host and p.pid  # host-tagged
+            # two sweeps -> every peer store holds series
+            c.poll_once()
+            for label, store in c.stores().items():
+                assert store.snapshot()["samples_total"] == 2, label
+        finally:
+            c.stop()
+            srv.shutdown()
+            srv2.shutdown()
+
+    def test_dead_peer_degrades_loudly_and_survivors_merge(self):
+        srv = TcpQueueServer(RingBuffer(10), host="127.0.0.1").serve_background()
+        srv2 = TcpQueueServer(RingBuffer(10), host="127.0.0.1").serve_background()
+        c = ClusterCollector(
+            [f"127.0.0.1:{srv.port}", f"127.0.0.1:{srv2.port}"],
+            register=False, pull_timeout_s=2.0,
+        )
+        try:
+            assert set(c.poll_once().values()) == {PEER_UP}
+            before = FLIGHT.count_of("collector_peer_down")
+            srv2.shutdown()
+            states = c.poll_once()
+            assert states[f"127.0.0.1:{srv.port}"] == PEER_UP
+            assert states[f"127.0.0.1:{srv2.port}"] == PEER_DOWN
+            # loud: a breadcrumb per transition, survivor unaffected
+            assert FLIGHT.count_of("collector_peer_down") == before + 1
+            snap = c.snapshot()
+            assert snap["peers_up"] == 1 and snap["peers_down"] == 1
+            # the dead peer's already-merged history is retained
+            dead = c.store(f"127.0.0.1:{srv2.port}")
+            assert dead.snapshot()["samples_total"] == 1
+        finally:
+            c.stop()
+            srv.shutdown()
+
+    def test_old_tcp_peer_marks_degraded(self, monkeypatch):
+        """A pre-ISSUE-13 server answers the metrics op with an error
+        dict (its GroupRegistry rejects the unknown op) — the peer must
+        surface as DEGRADED, loudly, never as silently absent."""
+        import psana_ray_tpu.transport.evloop as evloop_mod
+
+        srv = TcpQueueServer(RingBuffer(10), host="127.0.0.1").serve_background()
+        monkeypatch.setattr(
+            evloop_mod, "_metrics_rpc_payload",
+            lambda: (_ for _ in ()).throw(RuntimeError("old peer")),
+        )
+        c = ClusterCollector([f"127.0.0.1:{srv.port}"], register=False)
+        try:
+            before = FLIGHT.count_of("collector_peer_degraded")
+            states = c.poll_once()
+            assert list(states.values()) == [PEER_DEGRADED]
+            assert FLIGHT.count_of("collector_peer_degraded") == before + 1
+        finally:
+            c.stop()
+            srv.shutdown()
+
+    def test_http_peer_federate_and_healthz_fallback(self):
+        from psana_ray_tpu.obs.exporter import MetricsServer
+
+        reg = MetricsRegistry()
+        reg.register("fake", lambda: {"count_total": 3})
+        ms = MetricsServer(registry=reg, host="127.0.0.1", port=0).start()
+        # an OLD http peer: /healthz only (pre-/federate exporter)
+        import http.server
+
+        class _OldHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = json.dumps({"legacy": {"depth": 4}}).encode()
+                    self.send_response(200)
+                else:
+                    self.send_response(404)
+                    body = b"{}"
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        old = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _OldHandler)
+        t = threading.Thread(target=old.serve_forever, daemon=True)
+        t.start()
+        c = ClusterCollector(
+            [
+                f"http://127.0.0.1:{ms.port}",
+                f"http://127.0.0.1:{old.server_address[1]}",
+            ],
+            register=False,
+        )
+        try:
+            states = c.poll_once()
+            assert states[f"http://127.0.0.1:{ms.port}"] == PEER_UP
+            assert (
+                states[f"http://127.0.0.1:{old.server_address[1]}"]
+                == PEER_DEGRADED
+            )
+            up = c.store(f"http://127.0.0.1:{ms.port}")
+            assert up.last("fake.count_total") == 3.0
+            # the degraded peer's snapshot still merged
+            deg = c.store(f"http://127.0.0.1:{old.server_address[1]}")
+            assert deg.last("legacy.depth") == 4.0
+        finally:
+            c.stop()
+            ms.close()
+            old.shutdown()
+            old.server_close()
+
+    def test_federation_payload_shape(self):
+        p = federation_payload(MetricsRegistry())
+        assert p["ok"] and p["host"] and p["pid"] == os.getpid()
+        json.dumps(p)
+
+
+# ---------------------------------------------------------------------------
+# 3. SLO burn-rate alerts (deterministic synthetic peer)
+# ---------------------------------------------------------------------------
+
+class TestAlerts:
+    def _collector_with_synthetic_peer(self, monkeypatch, payloads):
+        import psana_ray_tpu.obs.collector as collector_mod
+
+        feed = iter(payloads)
+        monkeypatch.setattr(
+            collector_mod._Peer, "pull", lambda self, t: next(feed)
+        )
+        return ClusterCollector(
+            ["127.0.0.1:1"], register=False,
+            slo_target=0.99, burn_threshold=2.0, burn_window_s=60.0,
+        )
+
+    @staticmethod
+    def _payload(goodput, completed):
+        return {
+            "ok": True, "host": "h", "pid": 1,
+            "metrics": {
+                "gateway": {
+                    "goodput_total": goodput, "completed_total": completed,
+                }
+            },
+        }
+
+    def test_burn_alert_fires_once_and_clears(self, monkeypatch):
+        # window attainment 0.5 => burn (1-0.5)/(1-0.99) = 50 >> 2
+        c = self._collector_with_synthetic_peer(
+            monkeypatch,
+            [
+                self._payload(0, 0),
+                self._payload(50, 100),    # burning
+                self._payload(55, 110),    # still burning
+                self._payload(1055, 1110),  # recovery begins
+                self._payload(2055, 2110),  # in-window attainment back to 1.0
+            ],
+        )
+        before = FLIGHT.count_of("slo_alert")
+        c.poll_once(now=1000.0)
+        assert c.active_alerts() == []
+        c.poll_once(now=1010.0)
+        active = c.active_alerts()
+        assert [a["alert"] for a in active] == [ALERT_SLO_BURN]
+        assert FLIGHT.count_of("slo_alert") == before + 1
+        # still firing: edge-triggered, no second breadcrumb
+        c.poll_once(now=1020.0)
+        assert FLIGHT.count_of("slo_alert") == before + 1
+        assert c.snapshot()["alerts_active"] == 1
+        # recovery: once the burn WINDOW holds only clean completions
+        # (goodput == completed over the trailing 60 s), the gauge drops
+        # and the cleared crumb lands
+        cleared_before = FLIGHT.count_of("slo_alert_cleared")
+        c.poll_once(now=1070.0)
+        c.poll_once(now=1080.0)
+        assert c.active_alerts() == []
+        assert FLIGHT.count_of("slo_alert_cleared") == cleared_before + 1
+        assert c.snapshot()["alerts_fired_total"] >= 1
+
+    def test_stall_and_replication_alerts(self, monkeypatch):
+        payload = {
+            "ok": True, "host": "h", "pid": 1,
+            "metrics": {
+                "stalls": {"degraded": 1},
+                "replication": {"lag_records": 5000},
+            },
+        }
+        c = self._collector_with_synthetic_peer(monkeypatch, [payload])
+        c.poll_once(now=2000.0)
+        kinds = {a["alert"] for a in c.active_alerts()}
+        assert kinds == {"stall", "replication_lag"}
+
+
+# ---------------------------------------------------------------------------
+# 4. exemplars: histogram bucket -> trace_merge --exemplar -> timeline
+# ---------------------------------------------------------------------------
+
+class TestExemplars:
+    def test_latency_stats_retains_exemplar_per_bucket(self):
+        ls = LatencyStats()
+        ls.observe(0.004, exemplar=0xABC)   # le_5 bucket
+        ls.observe(0.180, exemplar=0xDEF)   # le_250 bucket
+        ls.observe(0.190)                   # no exemplar: keeps 0xDEF
+        ex = ls.exemplars()
+        assert ex["le_5"]["trace_id"] == "0xabc"
+        assert ex["le_250"]["trace_id"] == "0xdef"
+        snap = ls.snapshot()
+        assert snap["exemplars"]["le_250"]["ms"] == pytest.approx(180.0)
+        json.dumps(snap)
+
+    def test_exemplars_excluded_from_numeric_flatten(self):
+        """Exemplars are LINKS for the drill-down, not series: the
+        shared flatten grammar must skip the subtree whole — no bogus
+        per-bucket gauge on /metrics, no history ring per bucket."""
+        from psana_ray_tpu.obs.registry import flatten_numeric
+
+        ls = LatencyStats()
+        ls.observe(0.180, exemplar=0xDEF)
+        leaves = []
+        flatten_numeric(("lat",), ls.snapshot(), leaves)
+        keys = [k for k, _ in leaves]
+        assert not any("exemplar" in k for k in keys), keys
+        assert "lat.count" in keys  # the real series still flatten
+        # ...and therefore the history store never mints exemplar rings
+        store = TimeSeriesStore(capacity=8)
+        store.record({"lat": ls.snapshot()})
+        assert not any("exemplar" in k for k in store.keys())
+
+    def test_exemplar_resolves_through_trace_merge(self, tmp_path, capsys):
+        tid = 0x51AB
+        tr = Tracer().configure(str(tmp_path), sample_every=1, process="consumer")
+        t0 = time.monotonic()
+        tr.span(tid, "queue_dwell", t0, t0 + 0.010)
+        tr.span(tid, "dispatch", t0 + 0.010, t0 + 0.015)
+        tr.span(0x9999, "dispatch", t0, t0 + 0.001)  # another frame: filtered
+        tr.close()
+        out = str(tmp_path / "merged.json")
+        rc = trace_merge.main(
+            ["--exemplar", hex(tid), str(tmp_path), "--out", out]
+        )
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "queue_dwell" in printed and "dispatch" in printed
+        doc = json.load(open(out))
+        frame_spans = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e.get("cat") == "frame"
+        ]
+        assert len(frame_spans) == 2  # 0x9999 filtered out
+        assert all(
+            e["args"]["trace_id"] == hex(tid) for e in frame_spans
+        )
+
+    def test_exemplar_resolves_across_process_spools(self, tmp_path, capsys):
+        """The acceptance wording: a bucket's exemplar resolves to a
+        LINKED cross-host timeline — spans for one trace id from
+        multiple process spools merge onto one ordered timeline."""
+        tid = 0x7777
+        t0 = time.monotonic()
+        for proc, (name, a, b) in {
+            "producer": ("enqueue", 0.000, 0.001),
+            "queue_server": ("queue_dwell", 0.001, 0.012),
+            "consumer": ("dispatch", 0.012, 0.016),
+        }.items():
+            tr = Tracer().configure(str(tmp_path), sample_every=1, process=proc)
+            tr.span(tid, name, t0 + a, t0 + b)
+            tr.close()
+        rc = trace_merge.main(
+            ["--exemplar", hex(tid), str(tmp_path),
+             "--out", str(tmp_path / "m.json")]
+        )
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "3 process(es)" in printed
+        # ordered: enqueue before queue_dwell before dispatch
+        lines = [ln for ln in printed.splitlines() if "ms" in ln]
+        order = [
+            next(n for n in ("enqueue", "queue_dwell", "dispatch") if n in ln)
+            for ln in lines if any(
+                n in ln for n in ("enqueue", "queue_dwell", "dispatch")
+            )
+        ]
+        assert order == ["enqueue", "queue_dwell", "dispatch"]
+
+    def test_exemplar_not_found_exits_nonzero(self, tmp_path):
+        tr = Tracer().configure(str(tmp_path), sample_every=1, process="p")
+        tr.span(0x1, "dispatch", 0.0, 1.0)
+        tr.close()
+        rc = trace_merge.main(
+            ["--exemplar", "0xFFFF", str(tmp_path),
+             "--out", str(tmp_path / "o.json")]
+        )
+        assert rc == 1
+
+    def test_gateway_completion_stamps_exemplar(self):
+        """End to end inside one process: a sampled record through the
+        gateway tags the tenant latency histogram's bucket with its
+        trace id (the id trace_merge --exemplar then resolves)."""
+        from psana_ray_tpu.obs.tracing import TraceContext
+        from psana_ray_tpu.records import FrameRecord
+        from psana_ray_tpu.serving.gateway import ServingGateway
+        from psana_ray_tpu.serving.policy import SloPolicy
+        from psana_ray_tpu.serving.telemetry import GatewayTelemetry
+
+        clock = [0.0]
+        gw = ServingGateway(
+            dispatch=lambda recs, b: None,
+            policy=SloPolicy(slo_ms=100.0),
+            telemetry=GatewayTelemetry(register=False),
+            clock=lambda: clock[0],
+        )
+        tid = 0xBEEF
+        rec = FrameRecord(
+            0, 0, np.zeros((1, 4, 4), np.uint16), 9.5,
+            trace=TraceContext(trace_id=tid, sampled=True),
+        )
+        assert gw.offer(rec, tenant="t0")
+        clock[0] += 0.004
+        assert gw.dispatch_once() == 1
+        stats = gw.telemetry.stats()
+        ex = stats["t0"]["exemplars"]
+        assert any(v["trace_id"] == hex(tid) for v in ex.values())
+
+
+# ---------------------------------------------------------------------------
+# 5. obs.top --once over a live 3-process mini-cluster
+# ---------------------------------------------------------------------------
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _start_server(tmp_path, name):
+    port_file = str(tmp_path / f"port_{name}")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "psana_ray_tpu.queue_server",
+            "--port", "0", "--port_file", port_file,
+            "--stall_poll_s", "0", "--queue_size", "64",
+            "--history_interval", "0.2",
+        ],
+        cwd=REPO_ROOT,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, "queue server died on startup"
+        assert time.monotonic() < deadline, "server never wrote port file"
+        time.sleep(0.05)
+    return proc, int(open(port_file).read())
+
+
+class TestObsTopAcceptance:
+    def test_once_renders_federated_rows_over_three_processes(
+        self, tmp_path, capsys
+    ):
+        """The ISSUE 13 acceptance row: a live 3-process mini-cluster,
+        `obs.top --once` shows host-tagged federated series for all
+        three (state up, host:pid column, and the depth the frames we
+        pushed actually created)."""
+        procs = []
+        try:
+            servers = [_start_server(tmp_path, f"s{i}") for i in range(3)]
+            procs = [p for p, _ in servers]
+            ports = [port for _, port in servers]
+            # move real counters on server 0: 5 puts, 2 gets -> depth 3
+            cli = TcpQueueClient("127.0.0.1", ports[0], reconnect_tries=1)
+            from psana_ray_tpu.records import FrameRecord
+
+            for i in range(5):
+                assert cli.put_wait(
+                    FrameRecord(0, i, np.zeros((1, 8, 8), np.uint16), 9.5),
+                    timeout=10.0,
+                )
+            assert cli.get(deadline=time.monotonic() + 10) is not None
+            assert cli.get(deadline=time.monotonic() + 10) is not None
+            cli.disconnect()
+            peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+            rc = top_main(["--peers", peers, "--once", "--settle", "0.5"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            # all three processes present, host-tagged, state up
+            for port in ports:
+                assert f"127.0.0.1:{port}" in out
+            assert out.count(" up ") >= 3 or out.count("up") >= 3
+            # the server rows carry REAL host:pid tags from the payload
+            for proc in procs:
+                assert f":{proc.pid}" in out
+            # the pushed frames' depth is visible on server 0's row
+            row0 = next(
+                ln for ln in out.splitlines() if f"127.0.0.1:{ports[0]}" in ln
+            )
+            assert " 3 " in row0  # depth column: 5 put - 2 got
+            assert "sweeps=2" in out
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    def test_render_handles_empty_collector(self):
+        c = ClusterCollector(["127.0.0.1:1"], register=False)
+        try:
+            out = render(c)
+            assert "psana-ray obs.top" in out
+        finally:
+            c.stop()
+
+    def test_sparkline_shapes(self):
+        assert sparkline([]) == ""
+        assert sparkline([1.0, 1.0, 1.0]) == "▁▁▁"
+        s = sparkline(list(range(16)), width=8)
+        assert len(s) == 8 and s[-1] == "█"

@@ -1007,6 +1007,18 @@ def main(argv=None):
         lambda: _bench_wire_compression(extras, smoke),
     )
 
+    # ---------------- autotune: controller-on vs best hand-tuned ---------
+    # device-free (ISSUE 15): three regimes via the existing fault
+    # proxies (50 MB/s throttle, raw loopback, bursty arrivals) — the
+    # controller rows carry ZERO per-regime flags (codec=auto + live
+    # hill climber) and must hold >= 95% fps / <= 105% p99 vs the best
+    # per-regime hand flags, with the zero-copy pins intact
+    run_section(
+        wd,
+        "autotune",
+        lambda: _bench_autotune(extras, smoke),
+    )
+
     # ---------------- connection scaling: C10K event-loop server ---------
     # device-free: 16/128/1024 streamed subscribers, event-loop vs
     # thread-per-connection A/B (ISSUE 6)
@@ -2873,6 +2885,293 @@ def _bench_wire_compression(extras, smoke=False):
         log(
             f"wire compression: best speedup {best:.2f}x through the "
             f"{rate / 1e6:.0f} MB/s cap (acceptance >= 2x)"
+        )
+
+
+def _autotune_producer(port, codec_name, shape, total, seed, schedule=None):
+    """Subprocess body for the autotune A/B rows: a REAL producer
+    process (codec CPU on its own core, like every deployment), with an
+    optional deterministic arrival schedule (the bursty regime) and the
+    send wall-clock riding ``event_idx`` (int64 ns) so the consumer can
+    measure per-frame dwell without new wire surface."""
+    import time as _time
+
+    from psana_ray_tpu.records import EndOfStream, FrameRecord
+    from psana_ray_tpu.transport.tcp import TcpQueueClient
+
+    pool16 = _detector_like_frames(tuple(shape), seed)
+    client = TcpQueueClient(
+        "127.0.0.1", port, codec=codec_name or None
+    )
+    t0 = _time.monotonic()
+    for i in range(total):
+        if schedule is not None:
+            lag = schedule[i] - (_time.monotonic() - t0)
+            if lag > 0:
+                _time.sleep(lag)
+        rec = FrameRecord(0, _time.time_ns(), pool16[i % 4], 9.5)
+        while not client.put_pipelined(rec, deadline=_time.monotonic() + 2.0):
+            pass
+    client.flush_puts()
+    client.put_wait(EndOfStream(total_events=total), timeout=120.0)
+    client.disconnect()
+
+
+def _bench_autotune(extras, smoke=False):
+    """Autotune A/B (ISSUE 15): controller-on vs best-hand-tuned across
+    THREE regimes through the existing fault proxies —
+
+    - ``slow_link``: ThrottleProxy at ~50 MB/s both directions (the
+      tunnel regime wire compression exists for);
+    - ``loopback``: raw loopback (where the codec only burns CPU);
+    - ``bursty``: open-loop arrival_schedule bursts at a mean rate below
+      capacity (the latency regime — the metric is dwell p99, not fps).
+
+    The HAND rows carry each regime's best per-regime flags (codec
+    explicitly on for the throttle, off elsewhere — the PR 9 measured
+    choices). The CONTROLLER rows carry IDENTICAL flags in all three:
+    ``codec="auto"`` (the connect-time link-rate probe decides) plus a
+    live hill climber actuating the drain chunk/poll knobs mid-run.
+    Acceptance (ROADMAP item 3): controller >= 95% of hand fps in the
+    throughput regimes, <= 105% of hand dwell p99 in the bursty one,
+    codec auto-OFF at loopback / auto-ON through the throttle, and the
+    zero-copy pins (copies/frame 1.00, churn 0) unchanged with the
+    controller live."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests")
+    )
+    import subprocess as _subprocess
+
+    from faultproxy import ThrottleProxy, arrival_schedule
+
+    from psana_ray_tpu.autotune.controller import (
+        HillClimber,
+        Objective,
+        default_guardrails,
+    )
+    from psana_ray_tpu.autotune.knobs import (
+        KnobRegistry,
+        drain_chunk_knob,
+        drain_poll_knob,
+    )
+    from psana_ray_tpu.infeed.batcher import DrainControl, batches_from_queue
+    from psana_ray_tpu.obs.flight import FLIGHT
+    from psana_ray_tpu.obs.timeseries import TimeSeriesStore
+    from psana_ray_tpu.transport import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+    from psana_ray_tpu.utils.bufpool import BufferPool, WIRE
+
+    shape = (2, 32, 32) if smoke else (16, 352, 384)  # epix10k2M u16
+    n_frames = 8 if smoke else 24
+    warmup = 4 if smoke else 6
+    batch_size = 4 if smoke else 8
+    rate = 4e6 if smoke else 50e6  # slow-link bytes/s per direction
+    burst_hz = 40.0 if smoke else 24.0  # bursty mean rate (< capacity)
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def run_row(regime, codec_arg, autotune_on, pool=None):
+        """One (regime, config) row. Returns fps (steady), dwell p99 ms,
+        copies/frame, churn allocs/frame, consumer codec decision (None
+        for explicit codec args), autotune actuation count."""
+        pool = pool or BufferPool.default()
+        total = warmup + n_frames
+        srv = TcpQueueServer(
+            RingBuffer(batch_size * 4), host="127.0.0.1", pool=pool
+        ).serve_background()
+        proxy = None
+        schedule = None
+        if regime == "slow_link":
+            # small burst: the link-rate probe must see the CAP, not the
+            # token bucket's initial burst
+            proxy = ThrottleProxy("127.0.0.1", srv.port, rate, burst_s=0.005)
+        elif regime == "bursty":
+            schedule = list(arrival_schedule(
+                "burst", burst_hz, total / burst_hz, burst_factor=4.0,
+                period_s=0.5,
+            ))[:total]
+        port = proxy.port if proxy else srv.port
+        mark = FLIGHT.count_of("codec_auto_decision")
+        cons = TcpQueueClient("127.0.0.1", port, pool=pool, codec=codec_arg)
+        decision = None
+        if FLIGHT.count_of("codec_auto_decision") > mark:
+            # the consumer connect just decided (ring-eviction safe:
+            # the decision is the newest event of its kind)
+            for e in FLIGHT.events():
+                if e["kind"] == "codec_auto_decision":
+                    decision = bool(e["codec_on"])
+        control = DrainControl(chunk=batch_size, poll_s=0.002)
+        reg = KnobRegistry()
+        stop_ctl = threading.Event()
+        ctl_thread = None
+        seen_box = [0]
+        if autotune_on:
+            reg.register(drain_chunk_knob(control))
+            reg.register(drain_poll_knob(control))
+            store = TimeSeriesStore()
+            hc = HillClimber(
+                reg, Objective("bench.frames_total", window_s=2.0),
+                store=store, guardrails=default_guardrails(),
+                hold_ticks=1, settle_ticks=1, cooldown_ticks=1,
+            )
+
+            def _ctl():
+                while not stop_ctl.wait(0.25):
+                    store.record({"bench": {"frames_total": seen_box[0]}})
+                    try:
+                        hc.tick()
+                    except Exception:  # noqa: BLE001 — tuning never kills a row
+                        pass
+
+            ctl_thread = threading.Thread(target=_ctl, daemon=True)
+        child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = _subprocess.Popen(
+            [
+                sys.executable, "-c",
+                "import sys; sys.path.insert(0, %r); "
+                "sys.path.insert(0, %r); "
+                "from bench import _autotune_producer as p; "
+                "p(%d, %r, %r, %d, 11, schedule=%r)"
+                % (
+                    repo_root, os.path.join(repo_root, "tests"),
+                    port, codec_arg, tuple(shape), total, schedule,
+                ),
+            ],
+            env=child_env,
+        )
+
+        def watch_child():
+            rc = proc.wait()
+            if rc != 0:
+                srv.close_all()
+
+        try:
+            threading.Thread(target=watch_child, daemon=True).start()
+            if ctl_thread is not None:
+                ctl_thread.start()
+            c0 = WIRE.stats()
+            dwell_ns = []
+            seen = 0
+            t0 = time.perf_counter()
+            m0 = None
+            seen_at_mark = 0
+            for batch in batches_from_queue(
+                cons, batch_size, poll_interval_s=0.002, control=control
+            ):
+                now_ns = time.time_ns()
+                for idx in batch.event_idx[: batch.num_valid]:
+                    dwell_ns.append(now_ns - int(idx))
+                seen += batch.num_valid
+                seen_box[0] = seen
+                if m0 is None and seen >= warmup:
+                    m0 = pool.stats()
+                    t0 = time.perf_counter()
+                    seen_at_mark = seen
+                    del dwell_ns[:]  # dwell measured post-warmup only
+            dt = time.perf_counter() - t0
+            proc.wait(timeout=120)
+            if m0 is None or seen != total:
+                raise RuntimeError(f"autotune row saw {seen}/{total} frames")
+            c1, m1 = WIRE.stats(), pool.stats()
+            steady = max(1, seen - seen_at_mark)
+            copies = (c1["copies_total"] - c0["copies_total"]) / max(1, seen)
+            allocs = (m1["churn_misses"] - m0["churn_misses"]) / steady
+            dwell_ms = sorted(d / 1e6 for d in dwell_ns)
+            p99 = (
+                dwell_ms[min(len(dwell_ms) - 1, int(0.99 * len(dwell_ms)))]
+                if dwell_ms else None
+            )
+            acted = 0
+            if autotune_on:
+                snap = reg.snapshot()
+                acted = sum(
+                    snap[k]["actuations_total"]
+                    for k in ("drain_chunk", "drain_poll_s")
+                )
+            return steady / dt, p99, copies, allocs, decision, acted
+        finally:
+            stop_ctl.set()
+            if ctl_thread is not None:
+                ctl_thread.join(timeout=2)
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                cons.disconnect()
+            except Exception:
+                pass
+            if proxy:
+                proxy.close()
+            srv.shutdown()
+
+    def best_of(n, *args, **kw):
+        """Best row over n attempts (PR 5 wall-clock convention: host
+        contention only ever slows a run down). 'Best' = max fps for
+        the throughput regimes, min p99 for the bursty one."""
+        best = None
+        for _ in range(n):
+            r = run_row(*args, **kw)
+            if best is None:
+                best = r
+            elif args[0] == "bursty":
+                if r[1] is not None and (best[1] is None or r[1] < best[1]):
+                    best = r
+            elif r[0] > best[0]:
+                best = r
+        return best
+
+    # per-regime best hand flags (the PR 9 measured choices): codec on
+    # through the throttle, off where there is no bandwidth wall
+    hand_flags = {"slow_link": "shuffle-rle", "loopback": None, "bursty": None}
+    tries = 1 if smoke else 2
+    rows = {}
+    accept_all = True
+    for regime in ("slow_link", "loopback", "bursty"):
+        fps_h, p99_h, _, _, _, _ = best_of(tries, regime, hand_flags[regime], False)
+        ipool = BufferPool()  # instrumented: the controller-live pins
+        fps_c, p99_c, copies, allocs, decision, acted = best_of(
+            tries, regime, "auto", True, pool=ipool
+        )
+        if regime == "bursty":
+            ok = p99_h is not None and p99_c is not None and p99_c <= 1.05 * p99_h
+        else:
+            ok = fps_c >= 0.95 * fps_h
+        want_codec_on = regime == "slow_link"
+        codec_ok = decision is None or decision == want_codec_on
+        accept_all = accept_all and ok and codec_ok
+        rows[regime] = {
+            "hand_fps": round(fps_h, 2),
+            "hand_p99_ms": round(p99_h, 1) if p99_h is not None else None,
+            "hand_flags": hand_flags[regime] or "none",
+            "ctl_fps": round(fps_c, 2),
+            "ctl_p99_ms": round(p99_c, 1) if p99_c is not None else None,
+            "ctl_codec_decision_on": decision,
+            "ctl_copies_per_frame": round(copies, 3),
+            "ctl_allocs_per_frame": round(allocs, 3),
+            "ctl_actuations": acted,
+            "fps_ratio": round(fps_c / fps_h, 3) if fps_h else None,
+            "accept": bool(ok and codec_ok),
+        }
+        log(
+            f"autotune [{regime}]: hand {fps_h:.2f} fps"
+            f"{f' / p99 {p99_h:.0f} ms' if p99_h is not None else ''} "
+            f"({rows[regime]['hand_flags']}) vs controller {fps_c:.2f} fps"
+            f"{f' / p99 {p99_c:.0f} ms' if p99_c is not None else ''} "
+            f"(auto; codec_on={decision}, {acted} actuations, "
+            f"{copies:.2f} copies/frame, {allocs:.3f} allocs/frame) — "
+            f"{'OK' if rows[regime]['accept'] else 'MISS'}"
+        )
+    extras["autotune"] = rows
+    extras["autotune_accept_all"] = accept_all
+    if smoke:
+        log(
+            "autotune [smoke]: plumbing exercised; ratios are NOT "
+            "meaningful at smoke sizes (the throttle burst covers the "
+            "whole run) — acceptance comes from the full-size section"
+        )
+    else:
+        log(
+            f"autotune: controller-on with IDENTICAL flags across all "
+            f"three regimes {'meets' if accept_all else 'MISSES'} the "
+            f">=95% fps / <=105% p99 bar vs best hand-tuned"
         )
 
 

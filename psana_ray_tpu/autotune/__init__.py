@@ -1,0 +1,44 @@
+"""Autotune: the feedback controller that closes the loop on every
+pipeline knob (ISSUE 15, ROADMAP item 3).
+
+Three parts:
+
+- :mod:`~psana_ray_tpu.autotune.knobs` — the knob REGISTRY: each
+  tunable declares name, bounds, step quantum, actuation side,
+  cost-of-change, and a LIVE setter (the stream credit window, the
+  windowed-PUT depth, the batch drain chunk/poll, the prefetch depth,
+  the fsync batch, the pool retention floor, the wire codec);
+- :mod:`~psana_ray_tpu.autotune.controller` — a gradient-free hill
+  climber with per-group hysteresis that reads ONLY
+  :class:`~psana_ray_tpu.obs.timeseries.TimeSeriesStore` views and
+  probes one knob at a time, reverting on regression or any guardrail
+  trip;
+- :mod:`~psana_ray_tpu.autotune.daemon` — the in-process daemon thread
+  each CLI arms with ``--autotune on|off|observe``, plus the
+  ``autotune`` obs telemetry source.
+"""
+
+from psana_ray_tpu.autotune.controller import (
+    Guardrail,
+    HillClimber,
+    Objective,
+    default_guardrails,
+)
+from psana_ray_tpu.autotune.daemon import (
+    AutotuneDaemon,
+    add_autotune_args,
+    configure_autotune_from_args,
+)
+from psana_ray_tpu.autotune.knobs import Knob, KnobRegistry
+
+__all__ = [
+    "Knob",
+    "KnobRegistry",
+    "Objective",
+    "Guardrail",
+    "HillClimber",
+    "default_guardrails",
+    "AutotuneDaemon",
+    "add_autotune_args",
+    "configure_autotune_from_args",
+]

@@ -114,10 +114,11 @@ class TransportConfig:
     # wire compression (ISSUE 9, tcp:// and cluster:// transports):
     # codec(s) this endpoint ADVERTISES for its connections — the server
     # picks per connection (opcode 'Z'). "" = never negotiate (wire
-    # bytes identical to pre-codec builds); "auto" = everything this
-    # build implements (pure-numpy shuffle-rle always, lz4/bitshuffle
-    # when installed); or an explicit name / comma list. Old peers
-    # degrade the connection to uncompressed, loudly but not fatally.
+    # bytes identical to pre-codec builds); "auto" = decide per
+    # connection from a measured link-rate probe at (re)connect —
+    # compression on through slow links, off on fast LANs (ISSUE 15);
+    # or an explicit name / comma list. Old peers degrade the
+    # connection to uncompressed, loudly but not fatally.
     wire_codec: str = ""
     # opt-in LOSSY wire dtype narrowing applied by the PRODUCER before
     # encode ("" = off): e.g. "uint16" halves f32 frame bytes before

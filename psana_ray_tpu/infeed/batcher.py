@@ -26,6 +26,22 @@ from psana_ray_tpu.transport.registry import TransportClosed, TransportWedged
 from psana_ray_tpu.utils.bufpool import WIRE
 
 
+class DrainControl:
+    """Live dials for :func:`batches_from_queue` (ISSUE 15 autotune):
+    ``chunk`` is the max items per drain round trip (None = the
+    batcher's batch size, the pre-autotune behavior) and ``poll_s`` the
+    starvation poll interval (None = the call's ``poll_interval_s``).
+    The drain loop re-reads both every iteration — plain attribute
+    reads, GIL-atomic — so the autotune controller adjusts them from
+    its own thread with no lock on the hot path."""
+
+    __slots__ = ("chunk", "poll_s")
+
+    def __init__(self, chunk: Optional[int] = None, poll_s: Optional[float] = None):
+        self.chunk = chunk
+        self.poll_s = poll_s
+
+
 class StreamStalled(RuntimeError):
     """A stream went silent — no data AND no EOS for longer than the
     caller's stall budget. Distinct from :class:`TransportClosed` (the
@@ -235,6 +251,7 @@ def batches_from_queue(
     n_buffers: int = 0,
     raise_on_stall: bool = False,
     prefer_stream: bool = True,
+    control: Optional[DrainControl] = None,
 ) -> Iterator[Batch]:
     """Drain a transport queue into fixed-shape batches until EOS.
 
@@ -267,6 +284,11 @@ def batches_from_queue(
     119-126``); an :class:`EosTally` stops iteration only once every
     global shard is covered, and duplicate markers (copies meant for
     sibling consumers) are re-enqueued.
+
+    ``control`` (a :class:`DrainControl`) makes the pop chunk size and
+    the poll interval LIVE dials the autotune controller adjusts while
+    this loop runs (ISSUE 15); the batch SHAPE stays fixed regardless —
+    pjit compiles per shape, so only the drain granularity moves.
     """
     batcher: Optional[FrameBatcher] = None
     starved_since: Optional[float] = None
@@ -283,8 +305,17 @@ def batches_from_queue(
         while True:
             if stop is not None and stop.is_set():
                 return
+            # live dials (autotune): re-read per iteration, default to
+            # the call's own parameters when no controller is attached
+            chunk = batch_size
+            poll_s = poll_interval_s
+            if control is not None:
+                if control.chunk:
+                    chunk = max(1, int(control.chunk))
+                if control.poll_s:
+                    poll_s = float(control.poll_s)
             try:
-                items = pop(batch_size, timeout=poll_interval_s)
+                items = pop(chunk, timeout=poll_s)
             except TransportWedged:
                 # a peer crashed mid-claim and frames are stuck behind the
                 # wedge: this is data loss, NOT a clean end of stream —
@@ -305,7 +336,7 @@ def batches_from_queue(
                 # and the blocked sibling never gets it (the competing-
                 # consumer livelock; see EosTally.flush_duplicates)
                 if tally.flush_duplicates(queue):
-                    time.sleep(max(poll_interval_s, 0.02))
+                    time.sleep(max(poll_s, 0.02))
                 now = time.monotonic()
                 starved_since = starved_since if starved_since is not None else now
                 if max_wait_s is not None and now - starved_since >= max_wait_s:

@@ -70,6 +70,7 @@ class DevicePrefetcher:
             raise ValueError("prefetch_depth must be >= 1")
         self._src = batches
         self._sharding = sharding
+        self.prefetch_depth = prefetch_depth
         self._buf: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
         self._to_device = to_device or self._default_to_device
         self._err: Optional[BaseException] = None
@@ -115,6 +116,21 @@ class DevicePrefetcher:
             self._err = e
         finally:
             self._put(None)  # stream end marker (internal)
+
+    def set_prefetch_depth(self, n: int) -> int:
+        """Resize the staging buffer LIVE (ISSUE 15 autotune knob): the
+        queue's bound moves under its own mutex and any put blocked on
+        the old bound is woken. Shrinking never drops batches — already-
+        staged items stay; the bound applies to new puts. Returns the
+        depth now in effect. Callers that preallocate batch arenas must
+        respect the ``FrameBatcher.n_buffers`` aliasing contract —
+        :meth:`InfeedPipeline.set_prefetch_depth` enforces it."""
+        n = max(1, int(n))
+        with self._buf.mutex:
+            self._buf.maxsize = n
+            self._buf.not_full.notify_all()
+        self.prefetch_depth = n
+        return n
 
     def close(self, timeout: float = 5.0):
         """Stop the prefetch thread and release buffered batches."""
@@ -232,6 +248,7 @@ class InfeedPipeline:
             )
         self.queue = queue
         self.batch_size = batch_size
+        self._batcher_buffers = batcher_buffers
         self.metrics = metrics if metrics is not None else PipelineMetrics(queue=queue)
         self._obs_name = f"infeed.{name}" if name else None
         if self._obs_name:
@@ -257,6 +274,22 @@ class InfeedPipeline:
 
     def __iter__(self) -> Iterator[Batch]:
         return iter(self._prefetcher)
+
+    @property
+    def prefetch_depth(self) -> int:
+        return self._prefetcher.prefetch_depth
+
+    def set_prefetch_depth(self, n: int) -> int:
+        """Live prefetch-depth dial (ISSUE 15 autotune), clipped to the
+        batch-arena aliasing bound when arenas are pooled: a pooled
+        Batch is overwritten ``batcher_buffers`` batches later, so the
+        depth may never grow past ``batcher_buffers - 4`` (the
+        ``FrameBatcher.n_buffers`` contract this constructor validates
+        the static way). Returns the depth now in effect."""
+        n = max(1, int(n))
+        if self._batcher_buffers > 0:
+            n = min(n, max(1, self._batcher_buffers - 4))
+        return self._prefetcher.set_prefetch_depth(n)
 
     def close(self):
         self._prefetcher.close()

@@ -92,7 +92,7 @@ ALLOWLIST = (
     # call-graph cannot prove bounded ------------------------------------
     Allow(
         "blocking-hot-path", "infeed/batcher.py",
-        "time.sleep(max(poll_interval_s, 0.02))",
+        "time.sleep(max(poll_s, 0.02))",
         why="the PR 2 competing-consumer livelock fix: a deliberate "
         "scheduler yield after returning sibling EOS markers, taken only "
         "when starved, bounded by poll_interval — removing it re-opens "

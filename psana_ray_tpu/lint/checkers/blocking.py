@@ -41,24 +41,38 @@ from typing import Dict, List, Set, Tuple
 
 from psana_ray_tpu.lint.core import Checker, Finding, register
 
-ROOTS = {
-    "batches_from_queue",
-    "FrameBatcher.push",
-    "FrameBatcher.push_view",
-    "FrameBatcher.flush",
-    "FrameBatcher._emit",
-    "FanInPipeline._pump",
-    "FanInPipeline._put",
-    "FanInPipeline.__iter__",
-    "FanInPipeline.close",
+# root -> the file that defines it. The rot guard fires only when a
+# root's HOME FILE is in the scanned set but the root no longer
+# resolves there (a rename inside the file) — an incremental --changed
+# scan that happens not to include serving/ or infeed/ must not read
+# as rot (ISSUE 15: a >10-file diff without gateway.py false-fired the
+# old whole-tree heuristic). A deleted/renamed home file still trips
+# the guard on full-tree scans (the >50-file branch below).
+ROOT_HOME = {
+    "batches_from_queue": "infeed/batcher.py",
+    "FrameBatcher.push": "infeed/batcher.py",
+    "FrameBatcher.push_view": "infeed/batcher.py",
+    "FrameBatcher.flush": "infeed/batcher.py",
+    "FrameBatcher._emit": "infeed/batcher.py",
+    "FanInPipeline._pump": "infeed/fanin.py",
+    "FanInPipeline._put": "infeed/fanin.py",
+    "FanInPipeline.__iter__": "infeed/fanin.py",
+    "FanInPipeline.close": "infeed/fanin.py",
     # the serving gateway's dispatch loop (ISSUE 12): admission,
     # WDRR dispatch, and the transport pump sit directly on the
     # latency SLO — a sleep here IS a missed deadline
-    "ServingGateway.offer",
-    "ServingGateway.dispatch_once",
-    "ServingGateway.run",
-    "ServingGateway.serve_queue",
+    "ServingGateway.offer": "serving/gateway.py",
+    "ServingGateway.dispatch_once": "serving/gateway.py",
+    "ServingGateway.run": "serving/gateway.py",
+    "ServingGateway.serve_queue": "serving/gateway.py",
+    # the autotune controller's actuation path (ISSUE 15): every knob
+    # setter runs on the controller tick — a setter that sleeps or
+    # waits unboundedly stalls tuning AND (for client-side knobs under
+    # the client lock) the data path sharing that lock
+    "HillClimber.tick": "autotune/controller.py",
+    "KnobRegistry.apply": "autotune/knobs.py",
 }
+ROOTS = set(ROOT_HOME)
 
 # bare-name edges the getattr() transport-preference indirection hides.
 # NOTE: because edges resolve by BARE callee name, the get_batch_stream
@@ -186,23 +200,30 @@ class BlockingHotPathChecker(Checker):
 
     def run(self, index):
         table = _function_table(index)
-        # roots rot: if this is a real-tree scan (not a fixture run) and
-        # a hard-coded root no longer resolves, the checker would
-        # silently degrade to a no-op — the exact rot class the
-        # allowlist machinery guards against. Surface it instead.
-        if len(index.files) > 10:
-            for root in sorted(ROOTS - set(table)):
-                fi = index.find("lint/checkers/blocking.py")
-                yield Finding(
-                    checker=self.name,
-                    path=fi.rel if fi else "psana_ray_tpu/lint/checkers/blocking.py",
-                    line=0,
-                    message=f"drain-loop root {root!r} resolves to no "
-                    f"function in the scanned tree — the checker is "
-                    f"silently covering less than it claims",
-                    hint="the root was renamed or removed: update ROOTS "
-                    "(and SEED_EDGES) in this module to match",
-                )
+        # roots rot: a hard-coded root that no longer resolves silently
+        # degrades the checker to a no-op — the exact rot class the
+        # allowlist machinery guards against. Surface it — but only
+        # when the root's HOME FILE is in the scanned set (a rename
+        # inside it), or on a full-tree scan where the home file itself
+        # vanished; an incremental scan that merely excludes the file
+        # is not rot.
+        scanned = {fi.rel for fi in index.files}
+        for root in sorted(ROOTS - set(table)):
+            home = ROOT_HOME[root]
+            home_scanned = any(rel.endswith(home) for rel in scanned)
+            if not home_scanned and len(index.files) <= 50:
+                continue  # incremental scan without the home file
+            fi = index.find("lint/checkers/blocking.py")
+            yield Finding(
+                checker=self.name,
+                path=fi.rel if fi else "psana_ray_tpu/lint/checkers/blocking.py",
+                line=0,
+                message=f"drain-loop root {root!r} resolves to no "
+                f"function in the scanned tree — the checker is "
+                f"silently covering less than it claims",
+                hint="the root was renamed or removed: update ROOT_HOME "
+                "(and SEED_EDGES) in this module to match",
+            )
         by_bare: Dict[str, List[str]] = {}
         for qual in table:
             by_bare.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)

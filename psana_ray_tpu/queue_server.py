@@ -152,11 +152,13 @@ def main(argv=None):
             "empty or this many seconds pass, THEN closes (0 = abrupt)"
         ),
     )
+    from psana_ray_tpu.autotune import add_autotune_args
     from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
 
     add_metrics_args(p)
     add_trace_args(p)
     add_history_args(p)
+    add_autotune_args(p)
     p.add_argument(
         "--stall_poll_s", type=float, default=1.0,
         help="queue-health poll interval for the stall detector "
@@ -344,6 +346,35 @@ def main(argv=None):
         MetricsRegistry.default().register("stalls", stall)
         stall.start()
 
+    # autotune (ISSUE 15): server-side knobs — fsync batching and the
+    # RAM spill threshold on the default durable queue, plus the relay
+    # recv-pool retention floor — judged by the measured relay rate
+    # (gets/s on the default queue). Explicitly-set flags pin their
+    # knobs: the operator's value is a decision, not a default (a flag
+    # passed AT its default value reads as unset — documented).
+    autotune = None
+    if a.autotune != "off":
+        from psana_ray_tpu.autotune import Objective, configure_autotune_from_args
+        from psana_ray_tpu.autotune.knobs import (
+            bufpool_retention_knob,
+            fsync_batch_knob,
+            ram_items_knob,
+        )
+        from psana_ray_tpu.utils.bufpool import BufferPool
+
+        knobs = [bufpool_retention_knob(BufferPool.default())]
+        pinned = {}
+        if a.durable_dir:
+            knobs.append(fsync_batch_knob(backing.log))
+            knobs.append(ram_items_knob(backing))
+            if a.fsync_batch_n != dur_defaults.fsync_batch_n:
+                pinned["fsync_batch_n"] = "--fsync_batch_n set explicitly"
+            if a.ram_items != dur_defaults.ram_items:
+                pinned["ram_items"] = "--ram_items set explicitly"
+        autotune = configure_autotune_from_args(
+            a, knobs, Objective("queue_server.default.gets"), pinned=pinned
+        )
+
     done = threading.Event()
     force = threading.Event()
 
@@ -374,6 +405,8 @@ def main(argv=None):
             logger.warning(
                 "drain window ended with %d item(s) still queued", server.depth()
             )
+    if autotune is not None:
+        autotune.stop()
     if stall is not None:
         stall.stop()
     if history is not None:

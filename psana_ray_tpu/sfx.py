@@ -303,6 +303,7 @@ class SfxPipeline:
         cursor_save_every: int = 32,
         stop=None,
         max_events: Optional[int] = None,
+        drain_control=None,
     ) -> int:
         """Drain ``queue`` to EOS (or ``stop``/``max_events``) through the
         pipeline; returns events written this run.
@@ -333,7 +334,8 @@ class SfxPipeline:
         pending = None
         try:
             for batch in batches_from_queue(
-                queue, self.cfg.batch_size, poll_interval_s=poll_interval_s, stop=stop
+                queue, self.cfg.batch_size, poll_interval_s=poll_interval_s,
+                stop=stop, control=drain_control,
             ):
                 nxt = self.dispatch(batch)
                 if batch.hops:  # traced records -> per-stage spans
@@ -434,10 +436,12 @@ def main(argv=None):
         help="allow truncating an existing --output on a FRESH run "
         "(resumed runs — cursor already has positions — always append)",
     )
+    from psana_ray_tpu.autotune import add_autotune_args
     from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
     from psana_ray_tpu.transport.addressing import add_cluster_args
 
     add_cluster_args(ap, consumer=True)
+    add_autotune_args(ap)
 
     add_metrics_args(ap)
     add_trace_args(ap)
@@ -590,6 +594,8 @@ def main(argv=None):
     from psana_ray_tpu.obs import configure_tracing_from_args
 
     configure_tracing_from_args(a, "sfx", queue=monitor)
+    autotune = None
+    drain_control = None
     try:
         with CxiWriter(a.output, max_peaks=a.max_peaks, mode=writer_mode) as writer:
             # features already cross-checked above (one source of truth:
@@ -600,6 +606,33 @@ def main(argv=None):
             MetricsRegistry.default().register("sfx", pipe.metrics)
             if monitor is not None:
                 pipe.metrics.attach_queue(monitor)
+            # autotune (ISSUE 15): the drain chunk/poll dials plus the
+            # recv-pool retention floor, judged by the measured event
+            # rate. The drain-chunk knob sits in the `serving` group —
+            # it would defer to a bound gateway's SloPolicy.
+            if a.autotune != "off":
+                from psana_ray_tpu.autotune import (
+                    Objective,
+                    configure_autotune_from_args,
+                )
+                from psana_ray_tpu.autotune.knobs import (
+                    bufpool_retention_knob,
+                    drain_chunk_knob,
+                    drain_poll_knob,
+                )
+                from psana_ray_tpu.infeed.batcher import DrainControl
+                from psana_ray_tpu.utils.bufpool import BufferPool
+
+                drain_control = DrainControl(chunk=a.batch, poll_s=0.01)
+                autotune = configure_autotune_from_args(
+                    a,
+                    [
+                        drain_chunk_knob(drain_control),
+                        drain_poll_knob(drain_control),
+                        bufpool_retention_knob(BufferPool.default()),
+                    ],
+                    Objective("sfx.frames_total"),
+                )
             import time
 
             t0 = time.monotonic()
@@ -610,6 +643,7 @@ def main(argv=None):
                 cursor_save_every=a.cursor_save_every,
                 stop=stop_ev,  # SIGINT -> clean stop between batches
                 max_events=a.max_events,
+                drain_control=drain_control,
             )
             dt = time.monotonic() - t0
             log.info(
@@ -624,6 +658,8 @@ def main(argv=None):
         log.error("%s", e)
         return 1
     finally:
+        if autotune is not None:
+            autotune.stop()
         if history is not None:
             history.stop()
         if metrics_server is not None:

@@ -159,6 +159,15 @@ class DurableRingBuffer(RingBuffer):
             self._outstanding[id(item)] = entry
         return item
 
+    def set_ram_items(self, n: int) -> None:
+        """Live spill-threshold dial (ISSUE 15 autotune): RAM-resident
+        records admitted before new puts spill to log-only entries.
+        Applies to FUTURE puts — shrinking never evicts already-resident
+        entries (they drain through delivery), so the transition is
+        monotone and alloc-free."""
+        with self._lock:
+            self.ram_items = max(1, int(n))
+
     # -- replicated ack floor support (ISSUE 11) ---------------------------
     def put(self, item: Any) -> bool:
         """One admission implementation: :meth:`put_offset` is the

@@ -249,6 +249,16 @@ class SegmentLog:
                 seg.close()
                 os.unlink(seg.path)
 
+    def set_fsync_batch_n(self, n: int) -> None:
+        """Live fsync-batching dial (ISSUE 15 autotune): appends per
+        fsync under the ``batch`` policy. The pending-appends counter is
+        untouched, so a shrink takes effect at the very next append and
+        a grow simply stretches the current batch — durability
+        semantics (what a machine crash can lose) scale with the value,
+        exactly as the ``--fsync_batch_n`` flag documents."""
+        with self._lock:
+            self.fsync_batch_n = max(1, int(n))
+
     # -- append ------------------------------------------------------------
     def append(self, item) -> int:
         """Append one record; returns its assigned offset."""

@@ -101,10 +101,14 @@ def add_wire_args(parser, producer: bool = False) -> None:
     parser.add_argument(
         "--wire_codec", default="", metavar="auto|none|NAME[,NAME]",
         help="negotiate per-connection wire compression with the queue "
-        "server (tcp:// and cluster:// transports): 'auto' advertises "
-        "every codec this build implements (pure-numpy shuffle-rle "
-        "always; lz4/bitshuffle when installed), a name advertises "
-        "exactly that. The server picks; old servers degrade the "
+        "server (tcp:// and cluster:// transports): 'auto' DECIDES per "
+        "connection from a brief link-rate probe at connect — "
+        "compression on through slow links (tunnels), off on fast LANs "
+        "where the codec only burns CPU — re-decided on every "
+        "reconnect (codec_auto_decision flight breadcrumb either way; "
+        "works with --autotune off). A name advertises exactly that "
+        "codec (pure-numpy shuffle-rle always; lz4/bitshuffle when "
+        "installed). The server picks; old servers degrade the "
         "connection to uncompressed. Default: off (wire bytes "
         "byte-identical to pre-codec builds)",
     )

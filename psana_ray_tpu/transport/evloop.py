@@ -594,9 +594,13 @@ class _EvConn:
                 f"bad opcode {op:#04x} on replica connection"
             )
         if self.stream is not None:
-            # a streamed connection carries only acks and BYE upstream
+            # a streamed connection carries only acks, window resizes
+            # ('M' again — ISSUE 15 autotune), and BYE upstream
             if op == _OP_STREAM_ACK[0]:
                 self._expect(8, self._on_stream_ack)
+                return
+            if op == _OP_STREAM[0]:
+                self._expect(4, self._stream_resize)
                 return
             if op == _OP_BYE[0]:
                 self._finish_stream(clean=True)
@@ -933,6 +937,26 @@ class _EvConn:
         self.loop.add_stream(self)
         self._await_op()  # from here: only 'K'/'F' upstream
 
+    def _stream_resize(self) -> None:
+        """'M' on an already-streamed connection (ISSUE 15 autotune):
+        resize the credit window in place — seq/acked/unacked state is
+        untouched, so the budget shifts immediately and the next 'K'
+        replenishes against the new window. No response, exactly like
+        the subscribe."""
+        (window,) = struct.unpack_from("<I", self._hdr)
+        window = max(1, min(int(window), 4096))
+        st = self.stream
+        old, st.window = st.window, window
+        if window != old:
+            STREAM.resized(old, window)
+            FLIGHT.record(
+                "stream_resize", port=self.srv.port, old=old, window=window
+            )
+            if window > old:
+                # new credit: the pump may have pushes waiting on budget
+                self.loop.queue_touched(self.queue)
+        self._await_op()
+
     def _on_stream_ack(self) -> None:
         (seq,) = struct.unpack_from("<Q", self._hdr)
         st = self.stream
@@ -1058,6 +1082,20 @@ class _EvConn:
         self._arm(memoryview(self._open_buf), self._cluster_finish)
 
     def _cluster_finish(self) -> None:
+        if self._open_buf[:13] == b'{"op": "ping"':
+            # link-rate probe fast path (ISSUE 15, --wire_codec auto):
+            # the client times its padded REQUEST through the link, so
+            # the answer must cost O(1) — parsing a 640 KB pad here
+            # would bill codec-decision bandwidth for JSON decode time
+            # and make every fast LAN look slow
+            payload = json.dumps(
+                {"ok": True, "nbytes": len(self._open_buf)}
+            ).encode()
+            self.send_parts(
+                [_ST_OK + struct.pack("<I", len(payload)), payload]
+            )
+            self._await_op()
+            return
         try:
             req = json.loads(self._open_buf.decode())
             if req.get("op") == "metrics":
@@ -1068,6 +1106,10 @@ class _EvConn:
                 # the collector surfaces as a loudly-degraded peer (the
                 # 'Z' old-peer precedent)
                 resp = _metrics_rpc_payload()
+            elif req.get("op") == "ping":
+                # non-prefix ping spellings still answer (the fast path
+                # above handles the probe's canonical byte layout)
+                resp = {"ok": True, "nbytes": len(self._open_buf)}
             else:
                 resp = self.srv.groups.handle(req)
         except Exception as e:  # noqa: BLE001 — a bad RPC must not kill the loop

@@ -162,7 +162,9 @@ link can stay full of in-flight work:
   frames are held server-side and RE-ENQUEUED (head placement) when the
   connection dies — at-least-once crash-redelivery, exactly as
   in-flight GETs. A streamed connection carries ONLY pushes downstream
-  and 'K'/'F' upstream; any other opcode on it is a protocol error.
+  and 'K'/'F' upstream — plus 'M' again as a live credit-window RESIZE
+  (ISSUE 15 autotune: the budget shifts in place, no response, seq
+  state untouched); any other opcode on it is a protocol error.
 - windowed PUT ('W'): up to W sequence-numbered puts in flight before
   the client blocks reading statuses. The server enqueues each (waiting
   for space — backpressure arrives as delayed acks) and answers
@@ -344,6 +346,12 @@ class StreamTelemetry:
     def closed(self, window: int):
         with self._lock:
             self.credit_window -= window
+
+    def resized(self, old: int, new: int):
+        """Live credit-window resize (ISSUE 15 autotune): adjust the
+        aggregate gauge without counting a new subscription."""
+        with self._lock:
+            self.credit_window += new - old
 
     def pushed(self, n: int):
         with self._lock:
@@ -884,13 +892,17 @@ class TcpQueueClient:
         tenant_weight: int = 1,
     ):
         """``codec`` opts this connection into wire compression (ISSUE
-        9): ``"auto"`` advertises every codec this build implements,
-        a name (or comma list) advertises exactly those; None/"none"
-        (the default) skips negotiation entirely — wire bytes stay
-        byte-identical to pre-codec clients. The SERVER picks the
-        codec (opcode 'Z'); an old server that answers the opcode with
-        a protocol error degrades this client to uncompressed, loudly
-        (flight breadcrumb), not fatally.
+        9): ``"auto"`` (ISSUE 15) DECIDES per connection from a brief
+        link-rate probe at connect — compression on when the measured
+        link is slower than the codec break-even rate (tunnels), off on
+        fast LANs where the codec only burns CPU — re-decided on every
+        reconnect, with a ``codec_auto_decision`` flight breadcrumb
+        either way; a name (or comma list) advertises exactly those;
+        None/"none" (the default) skips negotiation entirely — wire
+        bytes stay byte-identical to pre-codec clients. The SERVER
+        picks the codec (opcode 'Z'); an old server that answers the
+        opcode with a protocol error degrades this client to
+        uncompressed, loudly (flight breadcrumb), not fatally.
 
         ``tenant`` (ISSUE 12) names this connection's fair-share tenant
         and ``tenant_weight`` (1-64) its weight; both ride the same 'Z'
@@ -929,14 +941,18 @@ class TcpQueueClient:
         # old-peer latch that stops renegotiation storms on reconnect
         self._codec_arg = codec
         self._codec_names: Optional[List[str]] = None
-        if codec and codec != CODEC_NONE:
-            if codec == "auto":
-                self._codec_names = available_codecs() or None
-            else:
-                names = [n.strip() for n in codec.split(",") if n.strip()]
-                for n in names:
-                    get_codec(n)  # fail fast on unknown names
-                self._codec_names = names
+        # "auto" (ISSUE 15, the parked ISSUE 9 follow-up): the codec is
+        # DECIDED at connect from a brief link-rate probe — off on fast
+        # LANs where the codec CPU only costs, on through slow tunnels
+        # where the bandwidth win dominates — and RE-DECIDED on every
+        # reconnect (the link may have changed). Explicit names still
+        # mean exactly what they say.
+        self._codec_auto = codec == "auto"
+        if codec and codec != CODEC_NONE and not self._codec_auto:
+            names = [n.strip() for n in codec.split(",") if n.strip()]
+            for n in names:
+                get_codec(n)  # fail fast on unknown names
+            self._codec_names = names
         self._codec = None  # guarded-by: _lock
         self._codec_refused = False  # guarded-by: _lock
         # tenant hello (ISSUE 12): capability fields appended to the 'Z'
@@ -969,6 +985,12 @@ class TcpQueueClient:
             self._reconnect(e)  # raises TransportClosed when exhausted
         if namespace is not None or queue_name is not None:
             self.open(namespace or "default", queue_name or "default", maxsize)
+        if self._codec_auto:
+            with self._lock:
+                try:
+                    self._decide_auto_codec_raw()
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    self._reconnect(e)  # re-probes + renegotiates itself
         if self._codec_names or self._hello_fields:
             self._negotiate()
 
@@ -1052,6 +1074,101 @@ class TcpQueueClient:
             "codec_negotiated", host=self.host, port=self.port, codec=chosen
         )
 
+    # -- link-rate probe + auto codec decision (ISSUE 15) ------------------
+    # Bandwidth below which wire compression wins on this build: the
+    # pure-numpy codec moves ~200 MB/s at ~3x on detector frames, so the
+    # break-even link is ~rate x (1 - 1/ratio) ~ 133 MB/s; 125 keeps a
+    # margin on the codec side (a borderline LAN stays raw — the codec
+    # only costs CPU there). PSANA_AUTO_CODEC_MB_S overrides.
+    AUTO_CODEC_THRESHOLD_MB_S = 125.0
+    # Padded control-RPC size per bandwidth probe: large enough that the
+    # transfer time dominates RTT on any link slow enough to matter,
+    # small enough to stay far under the 1 MB control-plane cap. Three
+    # probes ship back to back and the MEDIAN decides — a token-bucket
+    # burst (or warm TCP window) can fake one fast sample, a scheduler
+    # blip one slow sample; the median survives either.
+    AUTO_CODEC_PROBE_BYTES = 640 * 1024
+
+    def _probe_link_raw(self) -> tuple:
+        """Measure (link MB/s, RTT s) on the current socket: RTT from
+        two 'A' anchor exchanges (min), bandwidth from timing padded 'N'
+        ping RPCs through the link (the server must read the whole
+        request before answering, so elapsed ~ RTT + bytes/bandwidth).
+        Runs only at connect/reconnect time, pre-stream — nothing is in
+        flight to desync. Caller holds ``self._lock``."""
+        # guarded-by-caller: _lock
+        sock = self._sock
+        rtt = float("inf")
+        for _ in range(2):
+            t0 = time.monotonic()
+            sock.sendall(_OP_ANCHOR + struct.pack("<dd", time.time(), t0))
+            self._status()
+            _recv_exact(sock, 16)
+            rtt = min(rtt, time.monotonic() - t0)
+        # hand-assembled so the bytes match the server's O(1) ping
+        # prefix fast path (evloop._cluster_finish) — a json.dumps of a
+        # 640 KB string costs client time the measurement would absorb
+        body = (
+            b'{"op": "ping", "pad": "'
+            + b"x" * self.AUTO_CODEC_PROBE_BYTES
+            + b'"}'
+        )
+        samples = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            sock.sendall(_OP_CLUSTER + struct.pack("<I", len(body)) + body)
+            self._status()
+            (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+            _recv_exact(sock, n)
+            elapsed = time.monotonic() - t0
+            samples.append(len(body) / max(elapsed - rtt, 1e-6) / 1e6)
+        # median of three: a token-bucket burst can fake ONE fast sample
+        # (the bucket drains under the first probe), a scheduler blip
+        # can fake ONE slow one — the median survives either
+        return sorted(samples)[1], rtt
+
+    def _decide_auto_codec_raw(self) -> None:
+        """One-shot ``codec="auto"`` decision for THIS connection: probe
+        the link, compare against the codec break-even rate, and set the
+        advert the next 'Z' exchange carries. A probe the peer refuses
+        (protocol error from an odd proxy) decides FOR compression —
+        the bandwidth-conservative fallback — and never fails the
+        transport. Caller holds ``self._lock``."""
+        # guarded-by-caller: _lock
+        import os
+
+        mb_s = rtt = None
+        try:
+            mb_s, rtt = self._probe_link_raw()
+        except (ConnectionError, socket.timeout, OSError):
+            raise  # real socket death: the caller's reconnect owns it
+        except Exception:  # noqa: BLE001 — a refused probe decides, not dies
+            pass
+        try:
+            threshold = float(
+                os.environ.get(
+                    "PSANA_AUTO_CODEC_MB_S", self.AUTO_CODEC_THRESHOLD_MB_S
+                )
+            )
+        except ValueError:  # a typo'd override decides at the default,
+            threshold = self.AUTO_CODEC_THRESHOLD_MB_S  # never fails connect
+        slow = mb_s is None or mb_s < threshold
+        self._codec_names = (available_codecs() or None) if slow else None
+        if self._codec_names is None:
+            # decided OFF: drop any previously negotiated codec NOW —
+            # with nothing to advertise no 'Z' follows, and a stale
+            # codec object would keep compressing onto a fresh
+            # connection that never negotiated
+            self._codec = None
+        FLIGHT.record(
+            "codec_auto_decision",
+            host=self.host, port=self.port,
+            link_mb_s=round(mb_s, 1) if mb_s is not None else None,
+            rtt_ms=round(rtt * 1e3, 2) if rtt is not None else None,
+            threshold_mb_s=threshold,
+            codec_on=bool(self._codec_names),
+        )
+
     def _encode_for_wire(self, item):
         """codec.encode_for_wire under this connection's negotiated
         codec — every put path calls this under the client lock (the
@@ -1060,6 +1177,89 @@ class TcpQueueClient:
         contract."""
         # guarded-by-caller: _lock
         return _wire_encode(item, self._codec, self._pool)
+
+    # -- live knob surface (ISSUE 15 autotune) -----------------------------
+    @property
+    def put_window(self) -> int:
+        with self._lock:
+            return self._put_window
+
+    def set_put_window(self, n: int) -> None:
+        """Resize the windowed-PUT pipeline depth live (autotune knob).
+        Purely client-side state: a shrink simply waits for more acks
+        before the next send; a grow admits more in-flight puts."""
+        with self._lock:
+            self._put_window = max(1, int(n))
+
+    @property
+    def stream_window(self) -> int:
+        with self._lock:
+            st = self._stream
+            return st.window if st is not None else 0
+
+    def set_stream_window(self, n: int) -> bool:
+        """Resize the stream credit window live (autotune knob): one 'M'
+        with the new credit count on the streamed connection — the
+        server adjusts its budget in place (no response, exactly like
+        the subscribe), and the next cumulative 'K' replenishes against
+        the new window. Requires an open subscription."""
+        n = max(1, min(int(n), 4096))
+        with self._lock:
+            if self._replay_args is not None:
+                # replay is pull-mode: no stream to resize (and the
+                # server kills 'M' on a replay connection)
+                raise RuntimeError(
+                    "set_stream_window on a replay connection — replay "
+                    "is pull-mode"
+                )
+            if self._stream is None:
+                raise RuntimeError(
+                    "set_stream_window needs an open stream subscription "
+                    "(call stream_open first)"
+                )
+            st = self._stream
+            if n == st.window:
+                return True
+            st.window = n  # before the send: a reconnect resubscribes with it
+            try:
+                self._sock.sendall(_OP_STREAM + struct.pack("<I", n))
+            except (ConnectionError, socket.timeout, OSError) as e:
+                self._reconnect(e)  # resubscribes at the NEW window
+            return True
+
+    @property
+    def codec_name(self) -> Optional[str]:
+        """The negotiated wire codec's name, or None when raw."""
+        with self._lock:
+            codec = self._codec
+        return getattr(codec, "name", None) if codec is not None else None
+
+    def renegotiate_codec(self, names=None) -> bool:
+        """Flip wire compression live (autotune knob): renegotiate this
+        connection's codec via a fresh 'Z' exchange — ``names`` is a
+        codec list to advertise, None/empty renegotiates down to raw.
+        Refused on streamed connections (a mid-push 'Z' would desync
+        the push framing; the reconnect-time auto decision owns those)
+        and a no-op after an old-peer refusal latched. Bounded: any
+        outstanding windowed-put acks drain under the probe deadline
+        first (their responses precede the 'Z' answer in the byte
+        stream). Returns True when a codec is now negotiated."""
+        if self._stream is not None:
+            raise RuntimeError(
+                "renegotiate_codec on a streamed connection — the codec "
+                "there is re-decided at (re)connect, not mid-push"
+            )
+        if names:
+            names = [str(n) for n in names]
+            for n in names:
+                get_codec(n)  # fail fast on unknown names
+        deadline = time.monotonic() + self.PROBE_DEADLINE_S
+        with self._lock:
+            if self._codec_refused:
+                return False
+            self._codec_names = names or None
+            self._retrying(self._negotiate_raw, deadline)
+            return self._codec is not None
 
     def _reconnect(self, cause: BaseException, deadline: Optional[float] = None):
         """Re-dial with exponential backoff and replay the named binding.
@@ -1114,6 +1314,22 @@ class TcpQueueClient:
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._binding is not None:
                     self._open_raw(*self._binding)
+                if (
+                    self._codec_auto
+                    and not self._codec_refused
+                    and deadline is None
+                ):
+                    # "auto" is a per-CONNECTION decision: the fresh
+                    # link may be a different link (failover through a
+                    # tunnel, a recovered LAN) — re-probe, re-decide.
+                    # NOT under a caller deadline: the ~2 MB probe
+                    # cannot fit a clipped dial timeout on exactly the
+                    # slow links it exists for (the previous decision
+                    # carries; the next deadline-less reconnect
+                    # re-decides). Reset the dial timeout first — the
+                    # probe must run under the patient one.
+                    self._sock.settimeout(self._timeout_s)
+                    self._decide_auto_codec_raw()
                 if self._codec_names or self._hello_fields:
                     # renegotiate BEFORE any payload-bearing replay: the
                     # windowed resend below must know whether this
@@ -1387,6 +1603,14 @@ class TcpQueueClient:
         side = self._side
         if side is None:
             ns, nm, ms = self._binding or (None, None, 0)
+            # "auto" inherits THIS connection's probe decision instead
+            # of re-probing: the side channel shares the link
+            codec_arg = self._codec_arg
+            with self._lock:
+                names = self._codec_names
+                put_window = self._put_window
+            if self._codec_auto:
+                codec_arg = ",".join(names) if names else None
             side = TcpQueueClient(
                 self.host,
                 self.port,
@@ -1397,8 +1621,8 @@ class TcpQueueClient:
                 reconnect_tries=self._reconnect_tries,
                 reconnect_base_s=self._reconnect_base_s,
                 pool=self._pool,
-                put_window=self._put_window,
-                codec=self._codec_arg,
+                put_window=put_window,
+                codec=codec_arg,
             )
             self._side = side
         return side

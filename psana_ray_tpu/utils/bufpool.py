@@ -216,6 +216,15 @@ class BufferPool:
                 free.append(buf)
                 self._bytes_pooled += cls_bytes
 
+    def set_min_per_class(self, n: int) -> None:
+        """Live retention-floor dial (ISSUE 15 autotune): the minimum
+        free buffers each size class keeps regardless of the adaptive
+        peak. A shrink trims lazily on the next release (the existing
+        decay path); a grow retains more on future releases — no
+        allocation happens here."""
+        with self._lock:
+            self.min_per_class = max(0, int(n))
+
     def leaks(self) -> List[str]:
         """Acquisition stacks of outstanding leases (debug mode only)."""
         with self._lock:

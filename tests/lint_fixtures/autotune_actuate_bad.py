@@ -1,0 +1,31 @@
+"""KNOWN-BAD: a sleep smuggled into the autotune actuation path.
+
+The controller tick and every knob setter it reaches run on the
+autotune daemon's loop (ISSUE 15) — and client-side setters run under
+the transport client's lock, which the DATA path shares. A setter that
+sleeps "to let the change settle" (or a tick that paces itself with
+``time.sleep``) therefore stalls tuning AND the hot path behind the
+shared lock (blocking-hot-path)."""
+
+import time
+
+
+class KnobRegistry:
+    def knob(self, name):
+        return self._knobs[name]
+
+    def apply(self, name, value, why="probe"):
+        knob = self.knob(name)
+        knob.set(value)
+        time.sleep(0.1)  # MUST FLAG: "let the change settle" on the loop
+        return value
+
+
+class HillClimber:
+    def __init__(self, registry):
+        self.registry = registry
+
+    def tick(self):
+        self.registry.apply("k", 2.0)
+        time.sleep(1.0)  # MUST FLAG: self-pacing belongs to the daemon wait
+        return None

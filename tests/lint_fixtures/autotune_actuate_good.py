@@ -1,0 +1,42 @@
+"""SANCTIONED: the autotune actuation idioms.
+
+Setters are bounded — an assignment under a lock, or a deadline-bounded
+wire exchange owned by the transport client; the controller tick never
+sleeps (pacing lives in the daemon's stoppable Event wait). None may
+flag (blocking-hot-path)."""
+
+import threading
+
+
+class KnobRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._knobs = {}
+
+    def knob(self, name):
+        with self._lock:
+            return self._knobs[name]
+
+    def apply(self, name, value, why="probe"):
+        knob = self.knob(name)
+        knob.set(value)  # bounded by the setter's own contract
+        return value
+
+
+class HillClimber:
+    def __init__(self, registry):
+        self.registry = registry
+
+    def tick(self):
+        self.registry.apply("k", 2.0)
+        return None
+
+
+class AutotuneDaemon:
+    def __init__(self, controller):
+        self.controller = controller
+        self._stop = threading.Event()
+
+    def _run(self):
+        while not self._stop.wait(2.0):  # bounded, stoppable pacing
+            self.controller.tick()

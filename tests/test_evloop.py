@@ -236,11 +236,27 @@ class TestRedelivery:
             while len(first) < 6 and time.monotonic() < deadline:
                 first.extend(c.get_batch_stream(6 - len(first), timeout=1.0))
             assert len(first) == 6
+            from psana_ray_tpu.transport.tcp import STREAM
+
+            inflight_before_ack = STREAM.stats()["inflight"]
             # coming back acks the previous six
             second = []
             while not second and time.monotonic() < deadline:
                 second = c.get_batch_stream(1, timeout=1.0)
             assert len(second) == 1 and second[0].event_idx == 6
+            # wait until the SERVER has processed the cumulative ack
+            # for 0..5 before killing the socket: closing with unread
+            # pushes in the client's receive buffer sends RST, which
+            # can flush the in-flight 'K' out of the server's receive
+            # queue — then ALL ten frames redeliver and the exact-tail
+            # assertion flakes under CPU load (measured 1/10 on a
+            # loaded box). The server-side prune drops inflight by 6.
+            ack_deadline = time.monotonic() + 5.0
+            while (
+                STREAM.stats()["inflight"] > inflight_before_ack - 6
+                and time.monotonic() < ack_deadline
+            ):
+                time.sleep(0.01)
             c._sock.close()  # crash with seq 7..10 un-ACKed
             deadline = time.monotonic() + 5.0
             while q.size() < 4 and time.monotonic() < deadline:

@@ -157,12 +157,30 @@ def test_cli_missing_path_is_a_usage_error_not_findings():
 
 
 def test_blocking_roots_rot_is_a_finding():
-    # a real-tree scan (>10 files) where no hard-coded drain-loop root
-    # resolves must say so, not silently degrade to a no-op
+    """A scan that INCLUDES a root's home file where the root no longer
+    resolves (the rename-inside-the-file rot class) must say so, not
+    silently degrade to a no-op — while an incremental scan that merely
+    EXCLUDES the home file (a --changed diff not touching serving/ or
+    infeed/, the ISSUE 15 false-fire) must stay quiet."""
+    import shutil
+
+    rot_dir = FIXTURES / "_tmp_rot_home" / "infeed"
+    rot_dir.mkdir(parents=True, exist_ok=True)
+    rot_file = rot_dir / "batcher.py"
+    rot_file.write_text("def something_else():\n    pass\n")
+    try:
+        result = run_lint(paths=[rot_file], checkers=["blocking-hot-path"])
+        assert any(
+            "resolves to no function" in f.message for f in result.findings
+        ), result.findings
+    finally:
+        shutil.rmtree(FIXTURES / "_tmp_rot_home")
+    # the non-firing half: a >10-file scan WITHOUT any home file is an
+    # incremental diff, not rot
     no_roots = sorted((REPO_ROOT / "psana_ray_tpu" / "lint").rglob("*.py"))
     assert len(no_roots) > 10
     result = run_lint(paths=no_roots, checkers=["blocking-hot-path"])
-    assert any(
+    assert not any(
         "resolves to no function" in f.message for f in result.findings
     ), result.findings
 
@@ -614,6 +632,48 @@ def test_blocking_checker_covers_the_gateway_dispatch():
     assert "get_batch_stream" in SEED_EDGES["serve_queue"]
 
 
+def test_blocking_checker_covers_the_autotune_actuation_path():
+    """ISSUE 15 satellite: the autotune controller's actuation path —
+    the controller tick and the knob-registry apply every setter runs
+    under — is inside the blocking-hot-path audited graph. A sleep
+    pacing a setter or the tick must flag (fixture pair), and the REAL
+    autotune package must scan clean (setters are lock-guarded
+    assignments or deadline-bounded client exchanges; pacing lives in
+    the daemon's stoppable Event wait)."""
+    bad = FIXTURES / "autotune_actuate_bad.py"
+    good = FIXTURES / "autotune_actuate_good.py"
+    flagged = run_lint(paths=[bad], checkers=["blocking-hot-path"], use_allowlist=False)
+    hits = [
+        f for f in flagged.findings
+        if "time.sleep" in f.message
+        and ("KnobRegistry.apply" in f.message or "HillClimber.tick" in f.message)
+    ]
+    assert len(hits) >= 2, flagged.findings
+    clean = run_lint(paths=[good], checkers=["blocking-hot-path"], use_allowlist=False)
+    assert not clean.findings, clean.findings
+    # ...and the shipped controller + knob factories are in the audited
+    # set with no findings
+    autotune_dir = REPO_ROOT / "psana_ray_tpu" / "autotune"
+    real = run_lint(
+        paths=sorted(autotune_dir.glob("*.py")),
+        checkers=["blocking-hot-path"],
+    )
+    assert not real.findings, real.findings
+    from psana_ray_tpu.lint.checkers.blocking import ROOTS
+
+    assert "HillClimber.tick" in ROOTS
+    assert "KnobRegistry.apply" in ROOTS
+
+
+def test_telemetry_discipline_covers_the_autotune_source():
+    """ISSUE 15 satellite: the ``autotune`` obs source (the knob
+    registry's snapshot) is a lock-owning snapshot class — the
+    telemetry-discipline checker must cover it and find it clean."""
+    knobs = REPO_ROOT / "psana_ray_tpu" / "autotune" / "knobs.py"
+    result = run_lint(paths=[knobs], checkers=["telemetry-discipline"])
+    assert not result.findings, result.findings
+
+
 def test_event_loop_checker_roots_resolve_and_real_loop_is_clean():
     """ISSUE 6 satellite: the event-loop-blocking checker must root at
     the REAL loop dispatch (EventLoop.run) and find the shipped loop
@@ -693,10 +753,14 @@ def test_flow_layer_protocol_pair_scans_clean_and_reconstructs():
     for op, rec in d["ops"].items():
         assert not rec["handler_missing"], op
         assert rec["senders"], f"{op} has no client sender"
-    # the streamed mode allows exactly ack + bye on both sides
+    # the streamed mode allows exactly ack + bye + the 'M' window
+    # RESIZE (ISSUE 15 autotune: same header as the subscribe, applied
+    # to the open stream) on both sides
     stream = d["modes"]["stream"]
     assert stream["opened_by"] == "_OP_STREAM"
-    assert stream["server_allowed"] == {"_OP_STREAM_ACK", "_OP_BYE"}
+    assert stream["server_allowed"] == {
+        "_OP_STREAM_ACK", "_OP_BYE", "_OP_STREAM",
+    }
     assert stream["client_attr"] == "_stream"
     # replay is pull-mode: stream subscribe is illegal server-side
     replay = d["modes"]["replay"]

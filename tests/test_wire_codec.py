@@ -182,7 +182,7 @@ class TestNegotiation:
     def test_client_negotiates_and_survives_reconnect(self):
         srv = TcpQueueServer(RingBuffer(4), host="127.0.0.1").serve_background()
         try:
-            c = TcpQueueClient("127.0.0.1", srv.port, codec="auto")
+            c = TcpQueueClient("127.0.0.1", srv.port, codec="shuffle-rle")
             assert c._codec is not None
             rec = FrameRecord(0, 1, detector_u16(), 9.5)
             assert c.put(rec)
@@ -234,7 +234,7 @@ class TestNegotiation:
         monkeypatch.delitem(evloop._OPS, ord("Z"))
         srv = TcpQueueServer(RingBuffer(4), host="127.0.0.1").serve_background()
         try:
-            c = TcpQueueClient("127.0.0.1", srv.port, codec="auto")
+            c = TcpQueueClient("127.0.0.1", srv.port, codec="shuffle-rle")
             assert c._codec is None and c._codec_refused
             rec = FrameRecord(0, 1, detector_u16((2, 32, 32)), 9.5)
             assert c.put(rec)  # reconnects (old server dropped us), raw
@@ -261,7 +261,7 @@ class TestNegotiation:
         monkeypatch.setattr(evloop, "negotiate_codec", lambda names: _Spoofed())
         srv = TcpQueueServer(RingBuffer(4), host="127.0.0.1").serve_background()
         try:
-            c = TcpQueueClient("127.0.0.1", srv.port, codec="auto")
+            c = TcpQueueClient("127.0.0.1", srv.port, codec="shuffle-rle")
             assert c._codec is None and c._codec_refused
             rec = FrameRecord(0, 1, detector_u16((2, 32, 32)), 9.5)
             assert c.put(rec)  # raw put on the still-healthy connection
@@ -277,7 +277,7 @@ class TestNegotiation:
             RingBuffer(16), host="127.0.0.1", pool=pool
         ).serve_background()
         try:
-            prod = TcpQueueClient("127.0.0.1", srv.port, pool=pool, codec="auto")
+            prod = TcpQueueClient("127.0.0.1", srv.port, pool=pool, codec="shuffle-rle")
             cons_c = TcpQueueClient(
                 "127.0.0.1", srv.port, pool=pool, codec="shuffle-rle"
             )
@@ -642,7 +642,7 @@ class TestStreamedCompressed:
             RingBuffer(16), host="127.0.0.1", pool=pool
         ).serve_background()
         try:
-            prod = TcpQueueClient("127.0.0.1", srv.port, pool=pool, codec="auto")
+            prod = TcpQueueClient("127.0.0.1", srv.port, pool=pool, codec="shuffle-rle")
             cons = TcpQueueClient(
                 "127.0.0.1", srv.port, pool=pool, codec="shuffle-rle"
             )

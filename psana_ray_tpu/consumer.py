@@ -23,6 +23,7 @@ import time
 from typing import Any, Optional
 
 from psana_ray_tpu.config import TransportConfig
+from psana_ray_tpu.obs.profiling.stagetag import TAG_DEQUEUE, set_stage, swap_stage
 from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord, is_eos
 from psana_ray_tpu.transport import EMPTY, RendezvousTimeout, TransportClosed
 
@@ -149,27 +150,36 @@ class DataReader:
         """Non-blocking read: FrameRecord | EndOfStream | None (empty).
         Parity: data_reader.py:31-37, with typed EOS instead of None."""
         self._check_connected()
+        prev = swap_stage(TAG_DEQUEUE)
         try:
             item = self._queue.get()
         except TransportClosed as e:
             raise DataReaderError(str(e)) from e
+        finally:
+            set_stage(prev)
         return None if item is EMPTY else item
 
     def read_wait(self, timeout: Optional[float] = None) -> Any:
         """Blocking read (no 1 s poll-sleep). None only on timeout."""
         self._check_connected()
+        prev = swap_stage(TAG_DEQUEUE)
         try:
             item = self._queue.get_wait(timeout=timeout)
         except TransportClosed as e:
             raise DataReaderError(str(e)) from e
+        finally:
+            set_stage(prev)
         return None if item is EMPTY else item
 
     def read_batch(self, max_items: int, timeout: Optional[float] = None) -> list:
         self._check_connected()
+        prev = swap_stage(TAG_DEQUEUE)
         try:
             return self._queue.get_batch(max_items, timeout=timeout)
         except TransportClosed as e:
             raise DataReaderError(str(e)) from e
+        finally:
+            set_stage(prev)
 
     def __iter__(self):
         """Iterate FrameRecords until the stream completes (the loop the
@@ -301,7 +311,12 @@ def main(argv=None):
         "summary; 0 = off",
     )
     from psana_ray_tpu.autotune import add_autotune_args
-    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import (
+        add_history_args,
+        add_metrics_args,
+        add_profile_args,
+        add_trace_args,
+    )
     from psana_ray_tpu.transport.addressing import (
         add_cluster_args,
         add_tenant_args,
@@ -311,6 +326,7 @@ def main(argv=None):
     add_metrics_args(p)
     add_trace_args(p)
     add_history_args(p)
+    add_profile_args(p)
     add_cluster_args(p, consumer=True)
     add_wire_args(p)
     add_tenant_args(p)
@@ -404,9 +420,12 @@ def main(argv=None):
     MetricsRegistry.default().register("consumer", metrics)
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
     # history ring (ISSUE 13): flight-dump tails + /federate consumers
-    from psana_ray_tpu.obs import configure_history_from_args
+    from psana_ray_tpu.obs import configure_history_from_args, configure_profiling_from_args
 
     history = configure_history_from_args(a)
+    # continuous profiler (ISSUE 16): --profile_hz 0 = off; the spool
+    # shares --profile_dir with the jax device trace
+    profiler = configure_profiling_from_args(a, "consumer")
     heartbeat_done = threading.Event()
     heartbeat = None
     if a.status_interval > 0:

@@ -18,6 +18,12 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.profiling.stagetag import (
+    TAG_BATCH,
+    TAG_DEQUEUE,
+    TAG_UNTAGGED,
+    set_stage,
+)
 from psana_ray_tpu.obs.stages import HOP_BATCH, HOP_DEQ, HOP_PUSH
 from psana_ray_tpu.obs.tracing import TRACE_KEY, TRACER
 from psana_ray_tpu.records import EndOfStream, EosTally, FrameRecord, mark_hop
@@ -314,6 +320,7 @@ def batches_from_queue(
                     chunk = max(1, int(control.chunk))
                 if control.poll_s:
                     poll_s = float(control.poll_s)
+            set_stage(TAG_DEQUEUE)  # profiler: bill the pop to "dequeue"
             try:
                 items = pop(chunk, timeout=poll_s)
             except TransportWedged:
@@ -363,6 +370,7 @@ def batches_from_queue(
             # prefetch_depth + 4 for it).
             ready: List[Batch] = []
             stream_done = False
+            set_stage(TAG_BATCH)  # profiler: the arena-copy section
             for pos, item in enumerate(items):
                 if isinstance(item, EndOfStream):
                     if tally.process(item):
@@ -406,8 +414,10 @@ def batches_from_queue(
                 if out is not None:
                     ready.append(out)
             del items  # drop any lingering record refs with the pop
+            set_stage(TAG_UNTAGGED)  # suspended-at-yield time is the consumer's
             yield from ready
             if stream_done:
                 return
     finally:
+        set_stage(TAG_UNTAGGED)
         tally.flush_duplicates(queue, final=True)

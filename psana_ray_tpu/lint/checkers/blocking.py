@@ -71,6 +71,11 @@ ROOT_HOME = {
     # the client lock) the data path sharing that lock
     "HillClimber.tick": "autotune/controller.py",
     "KnobRegistry.apply": "autotune/knobs.py",
+    # the continuous profiler's sampling loop (ISSUE 16): it runs ~97
+    # times a second in EVERY pipeline process — a sleep or unbounded
+    # wait here freezes the profile AND holds the GIL budget hostage
+    "FlameSampler._run": "obs/profiling/sampler.py",
+    "FlameSampler._sample_once": "obs/profiling/sampler.py",
 }
 ROOTS = set(ROOT_HOME)
 

@@ -37,6 +37,19 @@ And the telemetry plane (ISSUE 13):
   bucket; ``trace_merge --exemplar <id>`` resolves a bad bucket to the
   frame's merged cross-host timeline.
 
+And the continuous profiling plane (ISSUE 16):
+
+- **Flame sampling** — :mod:`psana_ray_tpu.obs.profiling`: an always-on
+  97 Hz stack sampler folding every thread into a bounded zero-alloc
+  trie, with on-CPU/waiting discrimination and per-stage attribution
+  via the obs/stages vocabulary;
+- **Cost model** — the ``prof`` registry source: per-process cpu_frac,
+  per-stage cpu_ms, and cpu_ns_per_frame / py_bytes_per_frame against
+  the wire counters;
+- **Merge** — ``python -m psana_ray_tpu.obs.prof_merge``: cluster-wide
+  flamegraphs (collapsed/speedscope) and cpu_frac counter tracks
+  overlaid on the trace_merge Perfetto timeline.
+
 Everything here is pure stdlib and importable without JAX.
 """
 
@@ -76,6 +89,18 @@ from psana_ray_tpu.obs.timeseries import (  # noqa: F401
     default_history,
 )
 from psana_ray_tpu.obs.collector import ClusterCollector  # noqa: F401
+from psana_ray_tpu.obs.profiling import (  # noqa: F401
+    FlameSampler,
+    ProfTelemetry,
+    StackTrie,
+    add_profile_args,
+    configure_profiling_from_args,
+    default_profiler,
+    profile_summary,
+    profile_top,
+    start_default_profiler,
+    stop_default_profiler,
+)
 from psana_ray_tpu.obs.tracing import (  # noqa: F401
     TRACER,
     TraceContext,

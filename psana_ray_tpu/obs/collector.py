@@ -95,6 +95,7 @@ class _Peer:
         self.state = PEER_DOWN  # until the first successful pull
         self.host = ""
         self.pid = 0
+        self.profile = None  # last profile summary (ISSUE 16), or None
         self.last_pull_wall = 0.0
         self.last_error = ""
         self.pulls_ok = 0
@@ -149,7 +150,7 @@ class PeerState:
     """Read-model row for one peer (what the console renders)."""
 
     __slots__ = (
-        "label", "kind", "state", "host", "pid", "age_s", "error",
+        "label", "kind", "state", "host", "pid", "age_s", "error", "profile",
     )
 
     def __init__(self, peer: _Peer, now: float):
@@ -158,6 +159,7 @@ class PeerState:
         self.state = peer.state
         self.host = peer.host
         self.pid = peer.pid
+        self.profile = peer.profile
         self.age_s = (now - peer.last_pull_wall) if peer.last_pull_wall else -1.0
         self.error = peer.last_error
 
@@ -232,6 +234,8 @@ class ClusterCollector:
                     )
                     peer.host = payload.get("host", peer.host) or peer.host
                     peer.pid = int(payload.get("pid", peer.pid) or 0)
+                    prof = payload.get("profile")
+                    peer.profile = prof if isinstance(prof, dict) else None
                     peer.last_pull_wall = now
                     peer.last_error = ""
                     peer.pulls_ok += 1

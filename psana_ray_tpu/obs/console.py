@@ -73,9 +73,13 @@ def _sum_rates(store, suffix: str, prefix: str, window_s: float) -> Optional[flo
     return total
 
 
-def peer_row(label: str, state, store, window_s: float = 30.0) -> dict:
+def peer_row(label: str, state, store, window_s: float = 30.0,
+             profile: Optional[dict] = None) -> dict:
     """Extract one display row from a peer's series store (None = the
-    peer never published that subsystem)."""
+    peer never published that subsystem). ``profile`` is the peer's
+    federated profile summary (ISSUE 16) — CPU% prefers its live
+    cpu_frac, falling back to the ``prof.cpu_frac`` series for peers
+    whose summary aged out of the payload."""
     fps = None
     fps_key = None
     for key in _FRAME_COUNTER_KEYS:
@@ -108,6 +112,16 @@ def peer_row(label: str, state, store, window_s: float = 30.0) -> dict:
                     for a, b in zip(pts, pts[1:]) if b[0] > a[0]
                 ]
                 break
+    cpu_frac = None
+    hot = ""
+    if isinstance(profile, dict):
+        cpu_frac = profile.get("cpu_frac")
+        hot_list = profile.get("hot") or []
+        if hot_list:
+            top = hot_list[0]
+            hot = f"{top.get('frame', '?')} {top.get('pct', 0.0):.0f}%"
+    if cpu_frac is None:
+        cpu_frac = store.last("prof.cpu_frac")
     return {
         "label": label,
         "state": state,
@@ -119,6 +133,8 @@ def peer_row(label: str, state, store, window_s: float = 30.0) -> dict:
         "shed_rate": store.rate("gateway.shed_total", window_s),
         "lag": store.last("replication.lag_records"),
         "spark": sparkline(spark_vals),
+        "cpu_pct": None if cpu_frac is None else 100.0 * cpu_frac,
+        "hot": hot,
     }
 
 
@@ -136,18 +152,21 @@ def render(collector: ClusterCollector, window_s: float = 30.0,
         f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(now))}",
         f"{'PEER':<28} {'ST':<9} {'HOST:PID':<18} {'FPS':>9} "
         f"{'DEPTH':>7} {'CREDIT':>7} {'RATIO':>6} {'SHED/s':>7} "
-        f"{'LAG':>6}  FPS HISTORY",
+        f"{'LAG':>6} {'CPU%':>5}  FPS HISTORY",
     ]
     for p in sorted(peers, key=lambda p: p.label):
         store = collector.store(p.label)
-        row = peer_row(p.label, p.state, store, window_s)
+        row = peer_row(p.label, p.state, store, window_s,
+                       profile=getattr(p, "profile", None))
         hostpid = f"{p.host}:{p.pid}" if p.host else "-"
+        hot = f"  hot: {row['hot']}" if row["hot"] else ""
         lines.append(
             f"{row['label']:<28.28} {row['state']:<9} {hostpid:<18.18} "
             f"{_fmt(row['fps']):>9} {_fmt(row['depth'], 0):>7} "
             f"{_fmt(row['credit'], 0):>7} {_fmt(row['ratio'], 2):>6} "
-            f"{_fmt(row['shed_rate']):>7} {_fmt(row['lag'], 0):>6}  "
-            f"{row['spark']}"
+            f"{_fmt(row['shed_rate']):>7} {_fmt(row['lag'], 0):>6} "
+            f"{_fmt(row['cpu_pct'], 0):>5}  "
+            f"{row['spark']}{hot}"
         )
         if p.state != PEER_UP and p.error:
             lines.append(f"  └─ {p.error[:100]}")

@@ -294,6 +294,16 @@ class FlightRecorder:
                     tail = hist.tail(TAIL_SAMPLES)
             except Exception as e:  # noqa: BLE001 — best-effort like metrics
                 tail = {"error": repr(e)}
+            # WHAT the process was burning CPU on when it stalled
+            # (ISSUE 16): top hot frames + per-stage cpu_ms from the
+            # live flame sampler — null when profiling is off
+            prof_top = None
+            try:
+                from psana_ray_tpu.obs.profiling import profile_top
+
+                prof_top = profile_top(16)
+            except Exception as e:  # noqa: BLE001 — best-effort like metrics
+                prof_top = {"error": repr(e)}
             doc = {
                 "reason": reason,
                 "trigger": trigger,
@@ -306,6 +316,7 @@ class FlightRecorder:
                 "events": events,
                 "metrics": metrics,
                 "timeseries_tail": tail,
+                "profile_top": prof_top,
                 "threads": _thread_stacks(),
             }
             if path is None:

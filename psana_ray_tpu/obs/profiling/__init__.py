@@ -1,0 +1,225 @@
+"""Continuous profiling plane (ISSUE 16).
+
+Always-on, low-overhead CPU attribution for every pipeline process:
+
+- :mod:`~psana_ray_tpu.obs.profiling.sampler` — 97 Hz flame sampler
+  folding every thread's stack into a bounded, allocation-free trie,
+  with per-thread on-CPU/waiting discrimination;
+- :mod:`~psana_ray_tpu.obs.profiling.stagetag` — thread-local stage
+  tags set at the existing obs/stages instrumentation points, so
+  samples bill to the enqueue/dequeue/batch/device_put vocabulary;
+- :mod:`~psana_ray_tpu.obs.profiling.costmodel` — the ``prof``
+  telemetry source: cpu_frac, per-stage cpu_ms, cpu_ns_per_frame and
+  py_bytes_per_frame against the wire counters;
+- :mod:`~psana_ray_tpu.obs.profiling.export` — collapsed-stack /
+  speedscope / spool dumps, merged cluster-wide by
+  ``python -m psana_ray_tpu.obs.prof_merge``.
+
+This package mirrors the process-global idiom of
+``obs.timeseries``: one default sampler per process
+(:func:`start_default_profiler` / :func:`default_profiler`), CLI flags
+via :func:`add_profile_args` (``--profile_hz 0`` = off), and
+best-effort read hooks (:func:`profile_top`, :func:`profile_summary`)
+that return ``None`` instead of raising when profiling is off — flight
+dumps and federation must never fail because the profiler is absent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import threading
+from typing import Optional
+
+from psana_ray_tpu.obs.profiling.stagetag import (  # noqa: F401
+    N_TAGS,
+    TAG_BATCH,
+    TAG_DEQUEUE,
+    TAG_DEVICE_PUT,
+    TAG_DISPATCH,
+    TAG_ENQUEUE,
+    TAG_NAMES,
+    TAG_OF_STAGE,
+    TAG_QUEUE_DWELL,
+    TAG_UNTAGGED,
+    current_tag,
+    set_stage,
+    stage_region,
+    swap_stage,
+)
+from psana_ray_tpu.obs.profiling.costmodel import ProfTelemetry  # noqa: F401
+from psana_ray_tpu.obs.profiling.sampler import (  # noqa: F401
+    DEFAULT_HZ,
+    FlameSampler,
+    StackTrie,
+)
+from psana_ray_tpu.obs.profiling.export import (  # noqa: F401
+    collapsed_lines,
+    frame_label,
+    load_spool,
+    parse_collapsed,
+    speedscope_doc,
+    spool_doc,
+    write_spool,
+)
+
+__all__ = [
+    "DEFAULT_HZ",
+    "FlameSampler",
+    "StackTrie",
+    "ProfTelemetry",
+    "stage_region",
+    "set_stage",
+    "swap_stage",
+    "current_tag",
+    "TAG_NAMES",
+    "collapsed_lines",
+    "parse_collapsed",
+    "speedscope_doc",
+    "spool_doc",
+    "write_spool",
+    "load_spool",
+    "frame_label",
+    "default_profiler",
+    "start_default_profiler",
+    "stop_default_profiler",
+    "profile_top",
+    "profile_summary",
+    "add_profile_args",
+    "configure_profiling_from_args",
+]
+
+
+# -- process-global wiring ---------------------------------------------------
+_default_lock = threading.Lock()
+_default_sampler: Optional[FlameSampler] = None
+_atexit_armed = False
+
+
+def default_profiler() -> Optional[FlameSampler]:
+    """The process's flame sampler, or None when profiling is off (the
+    flight recorder and federation ask on every dump — an absent
+    profiler must cost nothing and fail nothing)."""
+    with _default_lock:
+        return _default_sampler
+
+
+def start_default_profiler(
+    hz: float = DEFAULT_HZ,
+    spool_dir: Optional[str] = None,
+    process: str = "",
+    registry=None,
+) -> FlameSampler:
+    """Start (or return) THE process-global sampler, register the
+    ``prof`` source, and arm an atexit spool dump when ``spool_dir`` is
+    set. Idempotent: the first caller's hz/spool_dir win."""
+    global _default_sampler, _atexit_armed
+    with _default_lock:
+        if _default_sampler is None:
+            _default_sampler = FlameSampler(
+                hz=hz, process=process, spool_dir=spool_dir, registry=registry
+            ).start()
+            if not _atexit_armed:
+                _atexit_armed = True
+                atexit.register(stop_default_profiler)
+        return _default_sampler
+
+
+def stop_default_profiler() -> None:
+    """Stop + forget the process-global sampler, writing its spool when
+    one was requested (also the atexit hook; tests call it directly)."""
+    global _default_sampler
+    with _default_lock:
+        sampler, _default_sampler = _default_sampler, None
+    if sampler is not None:
+        sampler.stop()
+
+
+# -- best-effort read hooks (flight dumps, federation) -----------------------
+def profile_top(n: int = 16) -> Optional[dict]:
+    """Top-``n`` hot frames + per-stage cpu_ms from the live default
+    sampler; ``None`` when profiling is off (flight dumps embed the
+    result verbatim)."""
+    s = default_profiler()
+    if s is None:
+        return None
+    trie = s.trie
+    return {
+        "hz": s.hz,
+        "samples": trie.samples_total,
+        "on_cpu": trie.on_cpu_total,
+        "waiting": trie.waiting_total,
+        "hot": trie.hot_frames(n),
+        "stage_cpu_ms": s.stage_cpu_ms(),
+    }
+
+
+def profile_summary(top_n: int = 5) -> Optional[dict]:
+    """The compact per-process summary that rides
+    ``federation_payload`` (OUTSIDE the numeric ``metrics`` tree —
+    frame names are strings and the metric grammar drops strings):
+    CPU%, per-frame cost, and the hottest frames with self-sample
+    percentages. ``None`` when profiling is off."""
+    s = default_profiler()
+    if s is None:
+        return None
+    trie = s.trie
+    tel = s.telemetry
+    on = trie.on_cpu_total
+    hot = []
+    for h in trie.hot_frames(top_n):
+        hot.append(
+            {
+                "frame": h["frame"],
+                "self": h["self"],
+                "pct": (100.0 * h["self"] / on) if on else 0.0,
+            }
+        )
+    return {
+        "hz": s.hz,
+        "samples": trie.samples_total,
+        "on_cpu": on,
+        "cpu_frac": tel.cpu_frac,
+        "cpu_ns_per_frame": tel.cpu_ns_per_frame,
+        "py_bytes_per_frame": tel.py_bytes_per_frame,
+        "hot": hot,
+        "stage_cpu_ms": s.stage_cpu_ms(),
+    }
+
+
+# -- CLI wiring --------------------------------------------------------------
+def add_profile_args(parser) -> None:
+    """The shared ``--profile_hz`` / ``--profile_dir`` pair every
+    long-running CLI exposes (one definition, like
+    ``add_history_args``)."""
+    parser.add_argument(
+        "--profile_hz", type=float, default=DEFAULT_HZ,
+        help="continuous-profiler sample rate in Hz (flame sampler + "
+        "per-frame cost model; feeds flight dumps, federation, and "
+        "`python -m psana_ray_tpu.obs.prof_merge`); 0 = off",
+    )
+    try:
+        parser.add_argument(
+            "--profile_dir", default=None,
+            help="write a per-process profile spool "
+            "(<process>-<pid>.prof.json) here on exit, mergeable with "
+            "`python -m psana_ray_tpu.obs.prof_merge` (default: no spool)",
+        )
+    except argparse.ArgumentError:
+        # the consumer CLI already owns --profile_dir (jax device-trace
+        # logdir); the one directory serves both outputs — device traces
+        # land in timestamped subdirs, the CPU spool beside them
+        pass
+
+
+def configure_profiling_from_args(args, process: str = "") -> Optional[FlameSampler]:
+    """CLI entry: start the process-global profiler from the
+    ``add_profile_args`` flags (None when ``--profile_hz 0``)."""
+    hz = getattr(args, "profile_hz", 0.0) or 0.0
+    if hz <= 0:
+        return None
+    return start_default_profiler(
+        hz=hz,
+        spool_dir=getattr(args, "profile_dir", None),
+        process=process,
+    )

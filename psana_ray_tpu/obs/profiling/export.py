@@ -1,0 +1,169 @@
+"""Profile export formats: collapsed stacks, speedscope, spool JSON.
+
+Three consumers, three formats, one source of truth (the sampler's
+:class:`~psana_ray_tpu.obs.profiling.sampler.StackTrie`):
+
+- **collapsed** — Brendan Gregg's ``stage;frame;frame count`` lines,
+  pipeable straight into ``flamegraph.pl`` or ``inferno``;
+- **speedscope** — the https://speedscope.app sampled-profile JSON, for
+  interactive drill-down without any local tooling;
+- **spool** — the repo's own merge format: trie rows plus the clock
+  anchors (wall, mono pairs — the same alignment contract
+  ``obs.trace_merge`` uses) and the 1 Hz cpu_frac timeline, written per
+  process as ``<dir>/<process>-<pid>.prof.json`` and merged across a
+  cluster by ``python -m psana_ray_tpu.obs.prof_merge``.
+
+Stage names ride as the FIRST frame of every collapsed/speedscope
+stack, so stage attribution survives round-trips through tools that
+know nothing about this repo's vocabulary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "frame_label",
+    "collapsed_lines",
+    "parse_collapsed",
+    "speedscope_doc",
+    "spool_doc",
+    "write_spool",
+    "load_spool",
+]
+
+
+def frame_label(code) -> str:
+    """``file.py:qualname:lineno`` — the display key for one frame."""
+    name = getattr(code, "co_qualname", None) or code.co_name
+    return "%s:%s:%d" % (os.path.basename(code.co_filename), name, code.co_firstlineno)
+
+
+def collapsed_lines(trie, waiting: bool = False) -> List[str]:
+    """Collapsed-stack lines (on-CPU counts by default; ``waiting=True``
+    exports the off-CPU flame instead)."""
+    key = "off" if waiting else "on"
+    out: List[str] = []
+    for row in trie.rows():
+        count = row[key]
+        if count <= 0:
+            continue
+        parts = [row["stage"]]
+        parts.extend(row["frames"])
+        out.append("%s %d" % (";".join(parts), count))
+    return out
+
+
+def parse_collapsed(lines) -> List[Tuple[List[str], int]]:
+    """Inverse of :func:`collapsed_lines` (round-trip tests, ingest)."""
+    out: List[Tuple[List[str], int]] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        stack_s, _, count_s = line.rpartition(" ")
+        out.append((stack_s.split(";"), int(count_s)))
+    return out
+
+
+def speedscope_doc(trie, name: str = "psana-ray-tpu", waiting: bool = False) -> dict:
+    """A speedscope "sampled" profile: one sample per distinct
+    (stage, stack) path, weighted by its count."""
+    key = "off" if waiting else "on"
+    frames: List[dict] = []
+    index: Dict[str, int] = {}
+    samples: List[List[int]] = []
+    weights: List[int] = []
+
+    def fid(label: str) -> int:
+        i = index.get(label)
+        if i is None:
+            i = len(frames)
+            index[label] = i
+            frames.append({"name": label})
+        return i
+
+    total = 0
+    for row in trie.rows():
+        count = row[key]
+        if count <= 0:
+            continue
+        stack = [fid("stage: %s" % row["stage"])]
+        stack.extend(fid(lbl) for lbl in row["frames"])
+        samples.append(stack)
+        weights.append(count)
+        total += count
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [
+            {
+                "type": "sampled",
+                "name": name,
+                "unit": "none",
+                "startValue": 0,
+                "endValue": total,
+                "samples": samples,
+                "weights": weights,
+            }
+        ],
+        "exporter": "psana_ray_tpu.obs.profiling",
+        "name": name,
+    }
+
+
+def spool_doc(sampler) -> dict:
+    """The mergeable per-process profile document."""
+    trie = sampler.trie
+    anchors = list(sampler.anchors)
+    # a fresh anchor at dump time bounds clock drift over long runs
+    anchors.append({"wall": time.time(), "mono": time.monotonic()})
+    return {
+        "kind": "psana_ray_tpu.prof_spool",
+        "version": 1,
+        "meta": {
+            "process": sampler.process,
+            "pid": os.getpid(),
+            "hz": sampler.hz,
+            "start_wall": sampler.start_wall,
+            "start_mono": sampler.start_mono,
+        },
+        "anchors": anchors,
+        "totals": {
+            "samples": trie.samples_total,
+            "on_cpu": trie.on_cpu_total,
+            "waiting": trie.waiting_total,
+            "nodes": trie.n_nodes,
+            "overflow": trie.overflow_total,
+        },
+        "stage_totals": trie.stage_totals(),
+        "stage_cpu_ms": sampler.stage_cpu_ms(),
+        "cpu_series": [[t, v] for t, v in sampler.telemetry.cpu_timeline()],
+        "stacks": trie.rows(),
+    }
+
+
+def write_spool(sampler, directory: Optional[str] = None, path: Optional[str] = None) -> str:
+    """Serialise a sampler's spool to ``path`` or
+    ``<directory>/<process>-<pid>.prof.json``; returns the path."""
+    if path is None:
+        directory = directory or sampler.spool_dir or "."
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "%s-%d.prof.json" % (sampler.process, os.getpid()))
+    doc = spool_doc(sampler)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_spool(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("kind") != "psana_ray_tpu.prof_spool":
+        raise ValueError("%s is not a psana_ray_tpu profile spool" % path)
+    return doc

@@ -187,7 +187,7 @@ def federation_payload(registry: Optional[MetricsRegistry] = None) -> dict:
     collector merges queue servers and producer/consumer CLIs into the
     same host-tagged series store."""
     reg = registry if registry is not None else MetricsRegistry.default()
-    return {
+    payload = {
         "ok": True,
         "host": socket.gethostname(),
         "pid": os.getpid(),
@@ -195,3 +195,13 @@ def federation_payload(registry: Optional[MetricsRegistry] = None) -> dict:
         "mono": time.monotonic(),
         "metrics": reg.snapshot(),
     }
+    # continuous-profiler summary (ISSUE 16) rides OUTSIDE "metrics":
+    # hot-frame NAMES are strings and flatten_numeric would drop them.
+    # Absent/broken profiler must cost nothing — peers render "-".
+    try:
+        from psana_ray_tpu.obs.profiling import profile_summary
+
+        payload["profile"] = profile_summary()
+    except Exception:
+        payload["profile"] = None
+    return payload

@@ -28,6 +28,7 @@ import numpy as np
 
 from psana_ray_tpu.config import MaskConfig, PipelineConfig, RetrievalMode, SourceConfig, TransportConfig
 from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.profiling.stagetag import TAG_ENQUEUE, set_stage, swap_stage
 from psana_ray_tpu.obs.stages import HOP_ENQ, HOP_SRC, STAGE_ENQUEUE
 from psana_ray_tpu.obs.tracing import SPAN_PRODUCE, TRACER
 from psana_ray_tpu.records import EndOfStream, FrameRecord, mark_hop, narrow_panels
@@ -73,12 +74,16 @@ class _Sender:
         """Buffer + flush when full (windowed: ship immediately, blocking
         only when the in-flight window is full). False = transport
         closed/stopped."""
-        if self.windowed:
-            return self._send_windowed(rec)
-        self.pending.append(rec)
-        if len(self.pending) >= self.batch_size:
-            return self.flush()
-        return True
+        prev = swap_stage(TAG_ENQUEUE)
+        try:
+            if self.windowed:
+                return self._send_windowed(rec)
+            self.pending.append(rec)
+            if len(self.pending) >= self.batch_size:
+                return self.flush()
+            return True
+        finally:
+            set_stage(prev)
 
     def _send_windowed(self, rec) -> bool:
         t_try = time.monotonic()
@@ -115,6 +120,13 @@ class _Sender:
         is acknowledged (the durability point before EOS/barrier).
         False = transport closed/stopped (records may remain pending —
         the stream is dead either way)."""
+        prev = swap_stage(TAG_ENQUEUE)
+        try:
+            return self._drain_buffered()
+        finally:
+            set_stage(prev)
+
+    def _drain_buffered(self) -> bool:
         if self.windowed:
             while not self.stop.is_set():
                 try:
@@ -401,12 +413,18 @@ def parse_arguments(argv=None):
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--log_level", default="INFO")
     from psana_ray_tpu.autotune import add_autotune_args
-    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import (
+        add_history_args,
+        add_metrics_args,
+        add_profile_args,
+        add_trace_args,
+    )
     from psana_ray_tpu.transport.addressing import add_cluster_args, add_wire_args
 
     add_metrics_args(p)
     add_trace_args(p)
     add_history_args(p)
+    add_profile_args(p)
     add_cluster_args(p)
     add_wire_args(p, producer=True)
     add_autotune_args(p)
@@ -528,9 +546,12 @@ def main(argv=None):
     metrics_server = start_metrics_server(args.metrics_port, host=args.metrics_host)
     # history ring (ISSUE 13): feeds flight-dump tails + the /federate
     # endpoint's consumers; one daemon thread, --history_interval 0 = off
-    from psana_ray_tpu.obs import configure_history_from_args
+    from psana_ray_tpu.obs import configure_history_from_args, configure_profiling_from_args
 
     history = configure_history_from_args(args)
+    # continuous profiler (ISSUE 16): flame sampler + per-frame cost
+    # model; one daemon thread, --profile_hz 0 = off
+    profiler = configure_profiling_from_args(args, "producer")
     monitor = None
     if metrics_server is not None and str(config.transport.address).startswith(
         ("tcp://", "cluster://")

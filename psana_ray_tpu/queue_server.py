@@ -153,11 +153,17 @@ def main(argv=None):
         ),
     )
     from psana_ray_tpu.autotune import add_autotune_args
-    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import (
+        add_history_args,
+        add_metrics_args,
+        add_profile_args,
+        add_trace_args,
+    )
 
     add_metrics_args(p)
     add_trace_args(p)
     add_history_args(p)
+    add_profile_args(p)
     add_autotune_args(p)
     p.add_argument(
         "--stall_poll_s", type=float, default=1.0,
@@ -323,9 +329,12 @@ def main(argv=None):
     # metrics RPC (this server answers it regardless; the sampler adds
     # the local HISTORY dimension). One daemon thread, preallocated
     # rings, --history_interval 0 turns it off.
-    from psana_ray_tpu.obs import configure_history_from_args
+    from psana_ray_tpu.obs import configure_history_from_args, configure_profiling_from_args
 
     history = configure_history_from_args(a)
+    # continuous profiler (ISSUE 16): bills the event loop's dispatch
+    # pass to the "dispatch" stage; --profile_hz 0 = off
+    profiler = configure_profiling_from_args(a, "queue_server")
     # Tracing (relay spans: queue_dwell/relay per sampled frame) and the
     # flight recorder (dump-on-stall/SIGUSR2/exception — the black box for
     # wedged runs) arm from the shared --trace_dir/--flight_dir flags.

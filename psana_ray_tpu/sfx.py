@@ -437,7 +437,12 @@ def main(argv=None):
         "(resumed runs — cursor already has positions — always append)",
     )
     from psana_ray_tpu.autotune import add_autotune_args
-    from psana_ray_tpu.obs import add_history_args, add_metrics_args, add_trace_args
+    from psana_ray_tpu.obs import (
+        add_history_args,
+        add_metrics_args,
+        add_profile_args,
+        add_trace_args,
+    )
     from psana_ray_tpu.transport.addressing import add_cluster_args
 
     add_cluster_args(ap, consumer=True)
@@ -446,6 +451,7 @@ def main(argv=None):
     add_metrics_args(ap)
     add_trace_args(ap)
     add_history_args(ap)
+    add_profile_args(ap)
     ap.add_argument("--log_level", default="INFO")
     a = ap.parse_args(argv)
     logging.basicConfig(
@@ -569,9 +575,11 @@ def main(argv=None):
 
     metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
     # history ring (ISSUE 13): flight-dump tails + /federate consumers
-    from psana_ray_tpu.obs import configure_history_from_args
+    from psana_ray_tpu.obs import configure_history_from_args, configure_profiling_from_args
 
     history = configure_history_from_args(a)
+    # continuous profiler (ISSUE 16): --profile_hz 0 = off
+    profiler = configure_profiling_from_args(a, "sfx")
     # queue depth for scrapes over a DEDICATED handle, never the data
     # connection: over TCP any opcode on the data connection implicitly
     # ACKs its in-flight GET deliveries (transport.tcp serve loop), so a

@@ -226,6 +226,12 @@ class EvLoopTelemetry:
         self.dispatch_ms_max = 0.0  # guarded-by: _lock
         self.dispatch_ms_ewma = 0.0  # guarded-by: _lock
         self.timer_lag_ms_max = 0.0  # guarded-by: _lock
+        # busy fraction = time-in-dispatch / (dispatch + select): the
+        # loop-saturation signal ROADMAP item 4's elasticity controller
+        # keys on — 1.0 means the loop never reaches select() idle-wait
+        self.dispatch_s_total = 0.0  # guarded-by: _lock
+        self.select_s_total = 0.0  # guarded-by: _lock
+        self.busy_frac_ewma = 0.0  # guarded-by: _lock
 
     def ensure_registered(self):
         with self._lock:
@@ -254,13 +260,19 @@ class EvLoopTelemetry:
         with self._lock:
             self.refused_total += 1
 
-    def loop_pass(self, dispatch_ms: float):
+    def loop_pass(self, dispatch_ms: float, select_ms: float = 0.0):
         with self._lock:
             self.loops_total += 1
             self.dispatch_ms_last = dispatch_ms
             if dispatch_ms > self.dispatch_ms_max:
                 self.dispatch_ms_max = dispatch_ms
             self.dispatch_ms_ewma += 0.05 * (dispatch_ms - self.dispatch_ms_ewma)
+            self.dispatch_s_total += dispatch_ms * 1e-3
+            self.select_s_total += select_ms * 1e-3
+            span_ms = dispatch_ms + select_ms
+            if span_ms > 0.0:
+                frac = dispatch_ms / span_ms
+                self.busy_frac_ewma += 0.05 * (frac - self.busy_frac_ewma)
 
     def timer_lag(self, lag_ms: float):
         with self._lock:
@@ -279,6 +291,14 @@ class EvLoopTelemetry:
                 "dispatch_ms_max": round(self.dispatch_ms_max, 3),
                 "dispatch_ms_ewma": round(self.dispatch_ms_ewma, 3),
                 "timer_lag_ms_max": round(self.timer_lag_ms_max, 3),
+                "busy_frac": round(
+                    self.dispatch_s_total
+                    / (self.dispatch_s_total + self.select_s_total)
+                    if (self.dispatch_s_total + self.select_s_total) > 0.0
+                    else 0.0,
+                    6,
+                ),
+                "busy_frac_ewma": round(self.busy_frac_ewma, 6),
             }
 
     # obs registry source protocol
@@ -1542,10 +1562,17 @@ class EventLoop:
             return  # shutdown() closed the socket before we got here
         self._sel.register(srv._sock, selectors.EVENT_READ, self._ACCEPT)
         self._sel.register(self._waker_r, selectors.EVENT_READ, self._WAKER)
+        # stage-tag the dispatch half of each pass so the continuous
+        # profiler bills server CPU to "dispatch" (bound once here: the
+        # loop body must not pay an import)
+        from psana_ray_tpu.obs.profiling.stagetag import TAG_DISPATCH, TAG_UNTAGGED, set_stage
+
         try:
             while not srv._stop.is_set():
+                t_sel = time.monotonic()
                 events = self._sel.select(self._select_timeout())
                 t0 = time.monotonic()
+                set_stage(TAG_DISPATCH)
                 for key, mask in events:
                     data = key.data
                     if data is self._ACCEPT:
@@ -1556,7 +1583,10 @@ class EventLoop:
                         self._dispatch_conn(data, mask)
                 self._fire_timers()
                 self._pump_all()
-                EVLOOP.loop_pass((time.monotonic() - t0) * 1000.0)
+                set_stage(TAG_UNTAGGED)
+                EVLOOP.loop_pass(
+                    (time.monotonic() - t0) * 1000.0, (t0 - t_sel) * 1000.0
+                )
         finally:
             self._teardown()
 

@@ -247,6 +247,7 @@ from collections import deque
 from typing import Any, List, Optional
 
 from psana_ray_tpu.obs.flight import FLIGHT
+from psana_ray_tpu.obs.profiling.stagetag import TAG_ENQUEUE, set_stage, swap_stage
 from psana_ray_tpu.obs.stages import HOP_ENQ, STAGE_QUEUE_DWELL
 from psana_ray_tpu.obs.tracing import SPAN_RELAY, TRACER
 from psana_ray_tpu.records import mark_hop
@@ -1958,6 +1959,9 @@ class TcpQueueClient:
         if self._stream is not None:
             return self._side_channel().put_wait(item, timeout, poll_s)
         deadline = None if timeout is None else time.monotonic() + timeout
+        # bill this thread's CPU to "enqueue" for the continuous
+        # profiler until the put resolves (restored in the finally)
+        prev_tag = swap_stage(TAG_ENQUEUE)
         # the compressed bytes depend only on (item, codec), so the
         # encode is CACHED across full-queue retries — paying the codec
         # once per frame, not once per bounded-wait round trip — and
@@ -2001,6 +2005,7 @@ class TcpQueueClient:
                     return False
                 time.sleep(poll_s)
         finally:
+            set_stage(prev_tag)
             if cached is not None and cached[2] is not None:
                 cached[2].release()
 

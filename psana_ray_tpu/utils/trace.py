@@ -121,5 +121,12 @@ def annotate_stage(stage: str):
     that label the latency histograms on the metrics endpoint label the
     regions on the TensorBoard/Perfetto timeline, so a p99 outlier in
     ``queue_dwell`` vs ``device_put`` points at the same vocabulary in
-    both tools."""
-    return annotate(f"stage.{stage}")
+    both tools.
+
+    Also tags the calling thread for the continuous profiler
+    (ISSUE 16): flame samples taken inside the region bill to this
+    stage, so ``device_put``/``dispatch`` CPU shows up in the same
+    vocabulary on the CPU flame as on the device timeline."""
+    from psana_ray_tpu.obs.profiling.stagetag import stage_region
+
+    return stage_region(stage, annotate(f"stage.{stage}"))

@@ -1,0 +1,30 @@
+"""KNOWN-BAD: blocking primitives in the continuous profiler's loop.
+
+The flame sampler runs ~97 times a second in EVERY pipeline process.
+Pacing it with ``time.sleep`` makes it unstoppable for up to a period
+at shutdown and drifts against the sample clock; an unbounded ``join``
+in the sampling path can wedge the whole process behind a stuck
+sampled thread (blocking-hot-path)."""
+
+import sys
+import time
+
+
+class FlameSampler:
+    def __init__(self, trie):
+        self.trie = trie
+        self._stopping = False
+
+    def _run(self):
+        while not self._stopping:
+            self._sample_once()
+            time.sleep(0.0103)  # MUST FLAG: unstoppable pacing on the loop
+
+    def _sample_once(self):
+        frames = sys._current_frames()
+        for ident in frames:
+            self._bill(frames[ident])
+
+    def _bill(self, frame):
+        self.trie.sample(frame, True, 0)
+        time.sleep(0)  # MUST FLAG: yielding the GIL mid-sample skews counts

@@ -1,0 +1,39 @@
+"""SANCTIONED: the continuous profiler's sampling-loop idioms.
+
+Pacing is a bounded, stoppable ``Event.wait`` with drift correction;
+the sample body only walks frames and preallocated arrays; thread join
+at shutdown is timeout-bounded. None may flag (blocking-hot-path)."""
+
+import sys
+import threading
+import time
+
+
+class FlameSampler:
+    def __init__(self, trie, period):
+        self.trie = trie
+        self.period = period
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _run(self):
+        nxt = time.monotonic() + self.period
+        while True:
+            delay = nxt - time.monotonic()
+            if delay < 0.0:
+                nxt = time.monotonic() + self.period
+                delay = 0.0
+            if self._stop.wait(delay):  # bounded, stoppable pacing
+                break
+            self._sample_once()
+            nxt += self.period
+
+    def _sample_once(self):
+        frames = sys._current_frames()
+        for ident in frames:
+            self.trie.sample(frames[ident], True, 0)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)  # bounded shutdown join

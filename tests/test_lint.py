@@ -665,6 +665,59 @@ def test_blocking_checker_covers_the_autotune_actuation_path():
     assert "KnobRegistry.apply" in ROOTS
 
 
+def test_blocking_checker_covers_the_flame_sampler():
+    """ISSUE 16 satellite: the continuous profiler's sampling loop —
+    it fires ~97 times a second in EVERY pipeline process — is inside
+    the blocking-hot-path audited graph. A ``time.sleep`` pacing the
+    loop (or smuggled into the per-sample billing) must flag (fixture
+    pair), and the REAL sampler must scan clean (pacing is a bounded,
+    drift-corrected Event wait; shutdown join is timeout-bounded)."""
+    bad = FIXTURES / "prof_sample_bad.py"
+    good = FIXTURES / "prof_sample_good.py"
+    flagged = run_lint(paths=[bad], checkers=["blocking-hot-path"], use_allowlist=False)
+    hits = [
+        f for f in flagged.findings
+        if "time.sleep" in f.message and "FlameSampler" in f.message
+    ]
+    assert len(hits) >= 2, flagged.findings
+    clean = run_lint(paths=[good], checkers=["blocking-hot-path"], use_allowlist=False)
+    assert not clean.findings, clean.findings
+    # ...and the shipped profiler is in the audited set with no findings
+    prof_dir = REPO_ROOT / "psana_ray_tpu" / "obs" / "profiling"
+    real = run_lint(
+        paths=sorted(prof_dir.glob("*.py")),
+        checkers=["blocking-hot-path"],
+    )
+    assert not real.findings, real.findings
+    from psana_ray_tpu.lint.checkers.blocking import ROOTS
+
+    assert "FlameSampler._run" in ROOTS
+    assert "FlameSampler._sample_once" in ROOTS
+
+
+def test_sample_path_marker_covers_the_flame_sampler():
+    """ISSUE 16 satellite: the sampler's hot functions carry the
+    ``# lint: sample-path`` marker, so the telemetry-discipline
+    checker's allocation ban (no displays, no comprehensions, no
+    f-strings, no allocating builtins) guards them — and the shipped
+    package passes it."""
+    sampler_py = (
+        REPO_ROOT / "psana_ray_tpu" / "obs" / "profiling" / "sampler.py"
+    ).read_text()
+    from psana_ray_tpu.lint.checkers.telemetry import SAMPLE_MARKER
+
+    # the trie fold, the per-tick walk, and the on-CPU probe are all hot
+    assert sampler_py.count(SAMPLE_MARKER) >= 3, (
+        "the sampler hot path lost its sample-path markers"
+    )
+    prof_dir = REPO_ROOT / "psana_ray_tpu" / "obs" / "profiling"
+    real = run_lint(
+        paths=sorted(prof_dir.glob("*.py")),
+        checkers=["telemetry-discipline"],
+    )
+    assert not real.findings, real.findings
+
+
 def test_telemetry_discipline_covers_the_autotune_source():
     """ISSUE 15 satellite: the ``autotune`` obs source (the knob
     registry's snapshot) is a lock-owning snapshot class — the

@@ -1,0 +1,648 @@
+"""ISSUE 16 — continuous profiling plane.
+
+Covers the acceptance rows: the sampler's steady state allocates
+nothing (``sys.getallocatedblocks``, per repo tradition); sampling at
+97 Hz costs within a generous unit-test bound of the unprofiled run
+(the 3% gate lives in bench.py where the box is quiet); on a LIVE
+3-thread relay ≥80% of on-CPU samples bill to the canonical stage
+vocabulary; collapsed/speedscope exports round-trip; ``prof_merge``
+aligns two spools with wildly different monotonic epochs onto one
+wallclock axis; the CLI flags, federation payload, flight-dump block,
+evloop busy-fraction, and the bench baseline rule all exist.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from psana_ray_tpu.infeed.batcher import batches_from_queue
+from psana_ray_tpu.obs import prof_merge
+from psana_ray_tpu.obs.profiling import (
+    DEFAULT_HZ,
+    FlameSampler,
+    ProfTelemetry,
+    StackTrie,
+    add_profile_args,
+    configure_profiling_from_args,
+    default_profiler,
+    profile_summary,
+    profile_top,
+    start_default_profiler,
+    stop_default_profiler,
+)
+from psana_ray_tpu.obs.profiling.export import (
+    collapsed_lines,
+    load_spool,
+    parse_collapsed,
+    speedscope_doc,
+    spool_doc,
+    write_spool,
+)
+from psana_ray_tpu.obs.profiling.stagetag import (
+    N_TAGS,
+    TAG_BATCH,
+    TAG_DEVICE_PUT,
+    TAG_NAMES,
+    TAG_UNTAGGED,
+    current_tag,
+    set_stage,
+    stage_region,
+    swap_stage,
+)
+from psana_ray_tpu.obs.registry import MetricsRegistry, federation_payload
+from psana_ray_tpu.obs.stages import STAGES
+from psana_ray_tpu.records import EndOfStream, FrameRecord
+from psana_ray_tpu.transport.ring import RingBuffer
+from psana_ray_tpu.transport.tcp import TcpQueueClient, TcpQueueServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_default_profiler():
+    """Each test starts and ends with the process-global profiler off
+    (the CLI tests start one; it must not leak into the next test)."""
+    stop_default_profiler()
+    yield
+    stop_default_profiler()
+
+
+def _rec(i, shape=(2, 32, 32)):
+    return FrameRecord(0, i, np.full(shape, i % 7, np.uint16), 9.0)
+
+
+# ---------------------------------------------------------------------------
+# 1. vocabulary + stage tags
+# ---------------------------------------------------------------------------
+
+class TestStageTags:
+    def test_tag_names_pin_the_canonical_stage_vocabulary(self):
+        """TAG_NAMES[1:] IS obs.stages.STAGES — the profiler bills to
+        the exact vocabulary the latency histograms speak; drift here
+        would silently fork the stage taxonomy."""
+        assert tuple(TAG_NAMES[1:]) == tuple(STAGES)
+        assert TAG_NAMES[TAG_UNTAGGED] == "untagged"
+        assert N_TAGS == len(STAGES) + 1
+
+    def test_swap_and_restore(self):
+        assert current_tag() == TAG_UNTAGGED
+        prev = swap_stage(TAG_BATCH)
+        assert prev == TAG_UNTAGGED
+        assert current_tag() == TAG_BATCH
+        set_stage(prev)
+        assert current_tag() == TAG_UNTAGGED
+
+    def test_stage_region_nests_and_unwinds(self):
+        with stage_region("batch"):
+            assert current_tag() == TAG_BATCH
+            with stage_region("device_put"):
+                assert current_tag() == TAG_DEVICE_PUT
+            assert current_tag() == TAG_BATCH
+        assert current_tag() == TAG_UNTAGGED
+
+    def test_stage_region_delegates_to_inner_and_unknown_stage_is_untagged(self):
+        calls = []
+
+        class Inner:
+            def __enter__(self):
+                calls.append("enter")
+
+            def __exit__(self, *exc):
+                calls.append("exit")
+                return False
+
+        with stage_region("no_such_stage", Inner()):
+            assert current_tag() == TAG_UNTAGGED  # unknown name never raises
+        assert calls == ["enter", "exit"]
+        assert current_tag() == TAG_UNTAGGED
+
+
+# ---------------------------------------------------------------------------
+# 2. trie: zero-alloc steady state, bounded overflow
+# ---------------------------------------------------------------------------
+
+class TestStackTrie:
+    def test_sample_is_allocation_free_steady_state(self):
+        """The zero-alloc-on-sample contract (same pin as SeriesRing
+        /TimeSeriesStore): folding a warmed stack allocates nothing."""
+        trie = StackTrie()
+        f = sys._getframe()
+        for _ in range(200):  # warm: every path + code key seen
+            trie.sample(f, True, TAG_BATCH)
+            trie.sample(f, False, TAG_UNTAGGED)
+        before = sys.getallocatedblocks()
+        for _ in range(10_000):
+            trie.sample(f, True, TAG_BATCH)
+        grew = sys.getallocatedblocks() - before
+        assert grew <= 16, f"trie.sample allocated ({grew} blocks / 10k samples)"
+        assert trie.samples_total == 400 + 10_000
+
+    def test_overflow_bills_deepest_prefix_never_grows_past_cap(self):
+        trie = StackTrie(max_nodes=N_TAGS + 2, max_depth=16)
+
+        def deep(n):
+            if n == 0:
+                trie.sample(sys._getframe(), True, TAG_UNTAGGED)
+            else:
+                deep(n - 1)
+
+        for _ in range(8):
+            deep(10)
+        assert trie.n_nodes <= N_TAGS + 2
+        assert trie.overflow_total > 0
+        assert trie.samples_total == 8  # degraded profile, counted samples
+
+    def test_rows_and_hot_frames_read_back(self):
+        trie = StackTrie()
+        f = sys._getframe()
+        for _ in range(5):
+            trie.sample(f, True, TAG_BATCH)
+        rows = trie.rows()
+        assert rows and all(r["stage"] == "batch" for r in rows)
+        assert sum(r["on"] for r in rows) == 5
+        hot = trie.hot_frames(4)
+        assert hot and hot[0]["self"] == 5
+        assert "test_profiling" in hot[0]["frame"]
+        assert trie.stage_totals()["batch"]["on"] == 5
+
+
+# ---------------------------------------------------------------------------
+# 3. sampler: discrimination, sampler-path zero-alloc, overhead
+# ---------------------------------------------------------------------------
+
+class TestFlameSampler:
+    def test_hz_zero_rejected(self):
+        with pytest.raises(ValueError):
+            FlameSampler(hz=0.0)
+
+    def test_sample_once_is_allocation_free_steady_state(self):
+        """The whole sampling path — _current_frames snapshot, procfs
+        pread, tag lookup, trie fold — allocates nothing live after
+        warmup (transient snapshot dict/bytes are freed within the
+        call and don't count as growth)."""
+        s = FlameSampler(hz=97.0, process="pin", register=False)
+        s._own_ident = -1  # don't skip the calling thread
+        for _ in range(50):  # warm: register threads, open fds, grow trie
+            s._sample_once()
+        before = sys.getallocatedblocks()
+        for _ in range(1000):
+            s._sample_once()
+        grew = sys.getallocatedblocks() - before
+        assert grew <= 16, f"_sample_once allocated ({grew} blocks / 1k calls)"
+
+    def test_on_cpu_vs_waiting_discrimination_live(self):
+        """A spinning tagged thread bills mostly on-CPU; a sleeping
+        tagged thread bills mostly waiting. 97 Hz period (10.3ms) sits
+        above the 100 Hz USER_HZ accounting tick, so a busy thread
+        advances its CPU clock nearly every sample."""
+        stop = threading.Event()
+
+        def burner():
+            set_stage(TAG_BATCH)
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+        def sleeper():
+            set_stage(TAG_DEVICE_PUT)
+            stop.wait(5.0)
+
+        s = FlameSampler(hz=97.0, process="disc", register=False)
+        tb = threading.Thread(target=burner, daemon=True)
+        ts = threading.Thread(target=sleeper, daemon=True)
+        tb.start(), ts.start()
+        s.start()
+        time.sleep(1.5)
+        s.stop(write_spool=False)
+        stop.set()
+        tb.join(timeout=5), ts.join(timeout=5)
+        totals = s.trie.stage_totals()
+        burn = totals.get("batch", {"on": 0, "off": 0})
+        slp = totals.get("device_put", {"on": 0, "off": 0})
+        assert burn["on"] + burn["off"] >= 50  # ~145 expected at 97 Hz
+        assert burn["on"] > 0.6 * (burn["on"] + burn["off"]), totals
+        assert slp["off"] > 0.6 * (slp["on"] + slp["off"]), totals
+        assert s.trie.samples_total == s.trie.on_cpu_total + s.trie.waiting_total
+
+    def test_overhead_within_unit_test_bound(self):
+        """A/B the sampler against a fixed CPU-bound workload. The real
+        acceptance (3%) is measured in bench.py's quiet A/B harness
+        (host_datapath_prof_delta_pct); this unit test pins a generous
+        25% so a pathological regression (per-sample allocation, lock
+        on the hot path) fails fast anywhere."""
+        payload = np.random.default_rng(0).integers(
+            0, 1000, (4, 64, 64), dtype=np.uint16
+        )
+
+        def work():
+            t0 = time.perf_counter()
+            for i in range(300):
+                r = FrameRecord(0, i, payload, 9.0)
+                FrameRecord.from_bytes(r.to_bytes())
+            return time.perf_counter() - t0
+
+        work()  # warm caches/allocator
+        base = min(work() for _ in range(5))
+        s = FlameSampler(hz=97.0, process="ab", register=False).start()
+        try:
+            prof = min(work() for _ in range(5))
+        finally:
+            s.stop(write_spool=False)
+        assert s.trie.samples_total > 0  # it really sampled during B
+        # best-of-5 + a wide bound: shared CI boxes jitter more than the
+        # sampler costs, and a genuine regression (per-sample allocation,
+        # hot-path lock) shows up as 2-10x, not 25%
+        assert prof <= base * 1.25 + 0.05, (
+            f"97 Hz sampling cost {100 * (prof / base - 1):.1f}% "
+            f"(base {base * 1e3:.1f}ms, profiled {prof * 1e3:.1f}ms)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 4. stage attribution on a live relay (the ISSUE 16 acceptance row)
+# ---------------------------------------------------------------------------
+
+class TestLiveRelayAttribution:
+    def test_most_busy_samples_bill_to_known_stages(self):
+        """producer thread -> TCP queue server (evloop) -> consumer
+        drain, profiled end to end: ≥80% of on-CPU samples carry a
+        stage tag from the canonical vocabulary (put_wait tags enqueue,
+        the drain loop tags dequeue/batch, the evloop tags dispatch)."""
+        # pre-built OUTSIDE the profiled window (creation is untagged);
+        # 256 KB/frame makes the relay CPU-bound in encode/copy/decode,
+        # and cycling the list keeps it busy long enough (~2s) for the
+        # 97 Hz sampler to accumulate a judgeable on-CPU population
+        records = [_rec(i, shape=(8, 128, 128)) for i in range(300)]
+        n = len(records) * 5
+        srv = TcpQueueServer(RingBuffer(64), host="127.0.0.1").serve_background()
+        sampler = FlameSampler(hz=97.0, process="relay", register=False)
+
+        def produce():
+            c = TcpQueueClient("127.0.0.1", srv.port)
+            try:
+                for i in range(n):
+                    if not c.put_wait(records[i % len(records)], timeout=30):
+                        return
+                c.put_wait(EndOfStream(total_events=n), timeout=30)
+            finally:
+                c.disconnect()
+
+        consumer = TcpQueueClient("127.0.0.1", srv.port)
+        sampler.start()
+        prod = threading.Thread(target=produce, daemon=True)
+        prod.start()
+        seen = 0
+        try:
+            for batch in batches_from_queue(
+                consumer, batch_size=16, max_wait_s=60, prefer_stream=False
+            ):
+                seen += batch.num_valid
+        finally:
+            sampler.stop(write_spool=False)
+            prod.join(timeout=30)
+            consumer.disconnect()
+            srv.shutdown()
+        assert seen == n
+        totals = sampler.trie.stage_totals()
+        on_known = sum(
+            t["on"] for name, t in totals.items() if name != "untagged"
+        )
+        on_total = sampler.trie.on_cpu_total
+        assert on_total >= 20, f"too few busy samples to judge: {totals}"
+        frac = on_known / on_total
+        assert frac >= 0.8, (
+            f"only {100 * frac:.0f}% of {on_total} on-CPU samples billed "
+            f"to known stages: {totals}"
+        )
+        # the decomposition reaches more than one stage on a real relay
+        assert len([s for s in totals if s != "untagged"]) >= 2, totals
+
+
+# ---------------------------------------------------------------------------
+# 5. exports: collapsed / speedscope round trip, spool write+load
+# ---------------------------------------------------------------------------
+
+class TestExports:
+    def _trie(self):
+        trie = StackTrie()
+        f = sys._getframe()
+        for _ in range(7):
+            trie.sample(f, True, TAG_BATCH)
+        for _ in range(3):
+            trie.sample(f, False, TAG_UNTAGGED)
+        return trie
+
+    def test_collapsed_round_trip(self):
+        trie = self._trie()
+        lines = collapsed_lines(trie)
+        parsed = parse_collapsed(lines)
+        assert parsed and sum(c for _, c in parsed) == trie.on_cpu_total
+        for stack, _ in parsed:
+            assert stack[0] == "batch"  # stage rides as the first frame
+            assert any("test_profiling" in fr for fr in stack[1:])
+        waiting = parse_collapsed(collapsed_lines(trie, waiting=True))
+        assert sum(c for _, c in waiting) == trie.waiting_total
+
+    def test_speedscope_doc_shape(self):
+        trie = self._trie()
+        doc = speedscope_doc(trie, name="unit")
+        prof = doc["profiles"][0]
+        assert prof["type"] == "sampled"
+        assert sum(prof["weights"]) == prof["endValue"] == trie.on_cpu_total
+        nframes = len(doc["shared"]["frames"])
+        for stack in prof["samples"]:
+            assert all(0 <= i < nframes for i in stack)
+            assert doc["shared"]["frames"][stack[0]]["name"].startswith("stage: ")
+        json.dumps(doc)  # serialisable as-is
+
+    def test_spool_write_load_round_trip(self, tmp_path):
+        s = FlameSampler(hz=50.0, process="unit", register=False).start()
+        time.sleep(0.25)
+        s.stop(write_spool=False)
+        path = write_spool(s, directory=str(tmp_path))
+        assert path.endswith(f"unit-{os.getpid()}.prof.json")
+        doc = load_spool(path)
+        assert doc["kind"] == "psana_ray_tpu.prof_spool"
+        assert doc["meta"]["process"] == "unit" and doc["meta"]["hz"] == 50.0
+        assert doc["totals"]["samples"] == s.trie.samples_total
+        assert len(doc["anchors"]) >= 2  # start anchor + dump-time anchor
+        bogus = tmp_path / "not_a_spool.json"
+        bogus.write_text("{}")
+        with pytest.raises(ValueError):
+            load_spool(str(bogus))
+
+
+# ---------------------------------------------------------------------------
+# 6. prof_merge: clock alignment across monotonic epochs, CLI
+# ---------------------------------------------------------------------------
+
+def _spool_file(tmp_path, process, pid, wall0, mono0, leaf_on):
+    """A handcrafted spool: one stack, two cpu_frac ticks, one anchor."""
+    doc = {
+        "kind": "psana_ray_tpu.prof_spool",
+        "version": 1,
+        "meta": {
+            "process": process, "pid": pid, "hz": 97.0,
+            "start_wall": wall0, "start_mono": mono0,
+        },
+        "anchors": [{"wall": wall0, "mono": mono0}],
+        "totals": {
+            "samples": leaf_on + 2, "on_cpu": leaf_on, "waiting": 2,
+            "nodes": 9, "overflow": 0,
+        },
+        "stage_totals": {"batch": {"on": leaf_on, "off": 2}},
+        "stage_cpu_ms": {"batch": leaf_on * (1000.0 / 97.0)},
+        "cpu_series": [[mono0 + 1.0, 0.5], [mono0 + 2.0, 0.75]],
+        "stacks": [
+            {"stage": "batch", "frames": ["a.py:outer:1", "a.py:hot:9"],
+             "on": leaf_on, "off": 2},
+        ],
+    }
+    path = tmp_path / f"{process}-{pid}.prof.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+class TestProfMerge:
+    def test_merge_aligns_two_spools_with_distinct_mono_epochs(self, tmp_path):
+        """Golden: two processes whose monotonic clocks started ~4900s
+        apart but whose wallclocks nearly agree merge onto ONE unified
+        timeline — the counter events land within the wallclock skew,
+        not the monotonic epoch gap."""
+        a = _spool_file(tmp_path, "producer", 11, wall0=1000.0, mono0=100.0,
+                        leaf_on=3)
+        b = _spool_file(tmp_path, "consumer", 22, wall0=1001.0, mono0=5000.0,
+                        leaf_on=5)
+        doc = prof_merge.merge([str(tmp_path)])
+        prof = doc["profile"]
+        assert len(prof["processes"]) == 2
+        assert prof["on_cpu_total"] == 8 and prof["samples_total"] == 12
+        # hot frames aggregate by LEAF (self time) across processes
+        assert prof["hot"][0] == {"frame": "a.py:hot:9", "self": 8}
+        assert prof["stage_cpu_ms"]["batch"] == pytest.approx(
+            8 * (1000.0 / 97.0)
+        )
+        counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        assert len(counters) == 4 and all(
+            e["name"] == "cpu_frac" for e in counters
+        )
+        by_pid = {}
+        for e in counters:
+            by_pid.setdefault(e["pid"], []).append(e["ts"])
+        (first_a, first_b) = (min(ts) for ts in by_pid.values())
+        # unified axis: mono 101 @ offset +900 vs mono 5001 @ offset
+        # -3999 both land near wall 1001-1002 — within 5s, not 4900s
+        assert abs(first_a - first_b) < 5e6, (first_a, first_b)
+        names = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        assert {e["args"]["name"] for e in names} == {
+            "prof producer:11", "prof consumer:22"
+        }
+        del a, b
+
+    def test_merged_collapsed_prefixes_process(self, tmp_path):
+        _spool_file(tmp_path, "producer", 11, 1000.0, 100.0, leaf_on=3)
+        lines = prof_merge.merged_collapsed([str(tmp_path)])
+        assert lines == ["producer:11;batch;a.py:outer:1;a.py:hot:9 3"]
+        ss = prof_merge.merged_speedscope([str(tmp_path)])
+        assert ss["profiles"][0]["endValue"] == 3
+
+    def test_cli_main_writes_all_artifacts(self, tmp_path, capsys):
+        _spool_file(tmp_path, "producer", 11, 1000.0, 100.0, leaf_on=3)
+        _spool_file(tmp_path, "consumer", 22, 1001.0, 5000.0, leaf_on=5)
+        out = tmp_path / "merged.json"
+        folded = tmp_path / "cluster.folded"
+        ss = tmp_path / "cluster.ss.json"
+        rc = prof_merge.main([
+            str(tmp_path), "--out", str(out),
+            "--collapsed", str(folded), "--speedscope", str(ss),
+        ])
+        assert rc == 0
+        assert json.loads(out.read_text())["profile"]["samples_total"] == 12
+        assert len(folded.read_text().splitlines()) == 2
+        assert json.loads(ss.read_text())["profiles"][0]["endValue"] == 8
+        assert "merged 2 process profile(s)" in capsys.readouterr().out
+
+    def test_cli_main_no_spools_is_a_clean_error(self, tmp_path):
+        assert prof_merge.main([str(tmp_path / "empty")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# 7. cost model + the `prof` source
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_per_frame_cost_from_injected_counters(self):
+        frames = [0]
+        nbytes = [0]
+        tel = ProfTelemetry(frames_fn=lambda: frames[0], bytes_fn=lambda: nbytes[0])
+        tel.tick_cost_model(now=10.0)  # baseline tick
+        deadline = time.process_time() + 0.08  # burn ≥8 os.times ticks
+        x = 0
+        while time.process_time() < deadline:
+            x += 1
+        frames[0], nbytes[0] = 200, 1 << 20
+        tel.tick_cost_model(now=11.0)
+        assert tel.cpu_frac > 0.0
+        assert tel.cpu_ns_per_frame > 0.0
+        assert tel.py_bytes_per_frame == pytest.approx((1 << 20) / 200.0)
+        assert tel.ticks_total == 2 and tel.frames_seen == 200
+        assert len(tel.cpu_timeline()) == 2
+        snap = tel.snapshot()
+        assert snap["enabled"] == 0  # no sampler attached
+        for k in ("cpu_frac", "cpu_ns_per_frame", "py_bytes_per_frame"):
+            assert isinstance(snap[k], float)
+
+    def test_prof_source_registers_on_the_default_registry(self):
+        reg = MetricsRegistry.default()
+        assert "prof" not in reg.snapshot()
+        s = start_default_profiler(hz=50.0, process="unit")
+        try:
+            assert default_profiler() is s
+            assert start_default_profiler(hz=999.0) is s  # idempotent
+            snap = reg.snapshot()["prof"]
+            assert snap["enabled"] == 1 and snap["hz"] == 50.0
+        finally:
+            stop_default_profiler()
+        assert "prof" not in reg.snapshot()
+        assert default_profiler() is None
+
+
+# ---------------------------------------------------------------------------
+# 8. surfaces: federation, flight dumps, evloop busy fraction, CLI, bench
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_federation_payload_profile_block(self):
+        assert federation_payload()["profile"] is None  # off costs nothing
+        start_default_profiler(hz=50.0, process="fed")
+        try:
+            time.sleep(0.15)
+            prof = federation_payload()["profile"]
+            assert prof is not None and prof["hz"] == 50.0
+            for k in ("samples", "on_cpu", "cpu_frac", "cpu_ns_per_frame",
+                      "hot", "stage_cpu_ms"):
+                assert k in prof
+            json.dumps(prof)  # strings ride OUTSIDE the numeric metrics
+        finally:
+            stop_default_profiler()
+
+    def test_profile_top_and_summary_none_when_off(self):
+        assert profile_top() is None
+        assert profile_summary() is None
+
+    def test_flight_dump_embeds_profile_top(self, tmp_path):
+        from psana_ray_tpu.obs.flight import FlightRecorder
+
+        fl = FlightRecorder()
+        fl.record("unit_event", k=1)
+        p_off = fl.dump("off", path=str(tmp_path / "off.json"), force=True)
+        assert json.loads(open(p_off).read())["profile_top"] is None
+        start_default_profiler(hz=50.0, process="fl")
+        try:
+            time.sleep(0.15)
+            p_on = fl.dump("on", path=str(tmp_path / "on.json"), force=True)
+            top = json.loads(open(p_on).read())["profile_top"]
+            assert top["samples"] > 0 and "hot" in top and "stage_cpu_ms" in top
+        finally:
+            stop_default_profiler()
+
+    def test_evloop_busy_fraction(self):
+        from psana_ray_tpu.transport.evloop import EvLoopTelemetry
+
+        t = EvLoopTelemetry()
+        assert t.stats()["busy_frac"] == 0.0  # no passes yet: defined, idle
+        t.loop_pass(10.0, select_ms=10.0)
+        s = t.stats()
+        assert s["busy_frac"] == pytest.approx(0.5)
+        assert 0.0 < s["busy_frac_ewma"] <= 0.5
+        t.loop_pass(30.0, select_ms=0.0)
+        assert t.stats()["busy_frac"] == pytest.approx(0.8)  # 40 / 50
+
+    def test_cli_args_plumb(self):
+        p = argparse.ArgumentParser()
+        add_profile_args(p)
+        a = p.parse_args([])
+        assert a.profile_hz == DEFAULT_HZ and a.profile_dir is None
+        assert configure_profiling_from_args(
+            p.parse_args(["--profile_hz", "0"])
+        ) is None
+        s = configure_profiling_from_args(
+            p.parse_args(["--profile_hz", "53"]), process="unit"
+        )
+        try:
+            assert s is not None and s.hz == 53.0 and s.running
+        finally:
+            stop_default_profiler()
+        # the consumer CLI already owns --profile_dir (device traces):
+        # add_profile_args must tolerate the pre-existing flag
+        q = argparse.ArgumentParser()
+        q.add_argument("--profile_dir", default="existing")
+        add_profile_args(q)
+        assert q.parse_args([]).profile_dir == "existing"
+        # every long-running CLI wires the shared pair
+        for mod in ("producer.py", "consumer.py", "queue_server.py", "sfx.py"):
+            src = open(os.path.join(REPO_ROOT, "psana_ray_tpu", mod)).read()
+            assert "add_profile_args(" in src, mod
+            assert "configure_profiling_from_args(" in src, mod
+
+    def test_queue_server_help_advertises_the_flags(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "psana_ray_tpu.queue_server", "--help"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0
+        assert "--profile_hz" in out.stdout and "--profile_dir" in out.stdout
+
+    def test_bench_baseline_gates_cpu_ns_per_frame(self):
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from bench import compare_baseline
+        finally:
+            sys.path.remove(REPO_ROOT)
+        base = {"host_datapath_cpu_ns_per_frame": 1000.0}
+        bad = compare_baseline({"host_datapath_cpu_ns_per_frame": 1300.0}, base)
+        assert [r["rule"] for r in bad] == ["cpu_ns_per_frame"]
+        assert bad[0]["direction"] == "lower"
+        ok = compare_baseline({"host_datapath_cpu_ns_per_frame": 900.0}, base)
+        assert ok == []
+        within = compare_baseline({"host_datapath_cpu_ns_per_frame": 1100.0}, base)
+        assert within == []  # 10% < the 15% tolerance
+
+
+# ---------------------------------------------------------------------------
+# 9. spool -> prof_merge over a REAL sampler run (end-to-end smoke)
+# ---------------------------------------------------------------------------
+
+class TestEndToEndSpool:
+    def test_sampler_spool_merges(self, tmp_path):
+        s = FlameSampler(
+            hz=97.0, process="e2e", spool_dir=str(tmp_path), register=False
+        ).start()
+        stop = threading.Event()
+
+        def burner():
+            set_stage(TAG_BATCH)
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+        t = threading.Thread(target=burner, daemon=True)
+        t.start()
+        time.sleep(0.6)
+        stop.set()
+        t.join(timeout=5)
+        s.stop()  # writes the spool
+        doc = prof_merge.merge([str(tmp_path)])
+        prof = doc["profile"]
+        assert len(prof["processes"]) == 1
+        assert prof["processes"][0]["process"] == f"e2e:{os.getpid()}"
+        assert prof["samples_total"] == s.trie.samples_total > 0
+        assert "batch" in prof["stage_cpu_ms"]
+        assert prof["hot"], "a busy run must surface hot frames"

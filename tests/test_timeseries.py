@@ -560,6 +560,9 @@ class TestObsTopAcceptance:
             )
             assert " 3 " in row0  # depth column: 5 put - 2 got
             assert "sweeps=2" in out
+            # the ISSUE 16 CPU column rides the same federated payload
+            # (servers run the 97 Hz profiler by default)
+            assert "CPU%" in out
         finally:
             for p in procs:
                 p.terminate()

@@ -347,12 +347,17 @@ def prefetch_depth_knob(pipeline: Any, lo: int = 1, hi: int = 8) -> Optional[Kno
     )
 
 
-def fsync_batch_knob(log: Any, lo: int = 8, hi: int = 1024) -> Optional[Knob]:
-    """Segment-log appends per fsync (queue server, durable queues)."""
+def fsync_batch_knob(
+    log: Any, lo: int = 8, hi: int = 1024, name: str = "fsync_batch_n"
+) -> Optional[Knob]:
+    """Segment-log appends per fsync (queue server, durable queues).
+    ``name`` lets the server register one dial PER NAMED QUEUE
+    (``fsync_batch_n:<ns>/<queue>``) — each durable log tunes to its
+    own producer cadence instead of inheriting the default queue's."""
     if not hasattr(log, "set_fsync_batch_n"):
         return None
     return Knob(
-        "fsync_batch_n", group="durability", side=SIDE_SERVER,
+        name, group="durability", side=SIDE_SERVER,
         lo=lo, hi=hi, step=8,
         get=lambda: float(log.fsync_batch_n),
         set=lambda v: log.set_fsync_batch_n(int(v)),
@@ -360,16 +365,55 @@ def fsync_batch_knob(log: Any, lo: int = 8, hi: int = 1024) -> Optional[Knob]:
     )
 
 
-def ram_items_knob(queue: Any, lo: int = 8, hi: int = 4096) -> Optional[Knob]:
-    """RAM-resident records before spill on a DurableRingBuffer."""
+def ram_items_knob(
+    queue: Any, lo: int = 8, hi: int = 4096, name: str = "ram_items"
+) -> Optional[Knob]:
+    """RAM-resident records before spill on a DurableRingBuffer.
+    ``name`` allows per-named-queue registration, like
+    :func:`fsync_batch_knob`."""
     if not hasattr(queue, "set_ram_items"):
         return None
     return Knob(
-        "ram_items", group="durability", side=SIDE_SERVER,
+        name, group="durability", side=SIDE_SERVER,
         lo=lo, hi=hi, step=8,
         get=lambda: float(queue.ram_items),
         set=lambda v: queue.set_ram_items(int(v)),
         cost=2,
+    )
+
+
+def workers_knob(
+    current: int = 1, lo: int = 1, hi: Optional[int] = None
+) -> Optional[Knob]:
+    """Data-plane width (``--workers``) as a RECOMMENDATION-ONLY dial.
+
+    A forked worker fleet cannot resize live: the rendezvous partition
+    map and each durable log's single-owner contract are fixed at fork
+    time, so an in-place width change would strand queue state. The
+    setter therefore records the controller's preferred width (flight
+    breadcrumb + autotune snapshot) for the operator's next restart
+    instead of actuating. Declines on a single-core box — there is no
+    parallel width to buy, and recommending one would be noise."""
+    import os
+
+    ncpu = os.cpu_count() or 1
+    if ncpu <= 1:
+        return None
+    top = int(hi) if hi else ncpu
+    state = {"want": float(max(1, current))}
+
+    def _set(v: float) -> None:
+        want = int(v)
+        if want != int(state["want"]):
+            FLIGHT.record(
+                "workers_recommend", want=want, running=int(current)
+            )
+        state["want"] = float(want)
+
+    return Knob(
+        "workers", group="data_plane", side=SIDE_SERVER,
+        lo=lo, hi=top, step=1,
+        get=lambda: state["want"], set=_set, cost=4,
     )
 
 

@@ -49,7 +49,17 @@ from psana_ray_tpu.lint.checkers.blocking import (
     EDGE_STOP,
 )
 
-ROOTS = {"EventLoop.run"}
+ROOTS = {
+    "EventLoop.run",
+    # ISSUE 17 additions: the kernel pass-through pump runs inside the
+    # loop's flush path (os.sendfile must return short / raise
+    # BlockingIOError, never park the loop), and the worker fleet's
+    # reap-and-respawn loop must stay deadline-bounded so SIGTERM always
+    # lands within a wait slice — both audited from their own roots so
+    # a refactor that detaches them from EventLoop.run keeps coverage
+    "_EvConn._pump_span",
+    "WorkerSupervisor._supervise",
+}
 
 EXCLUDE_PREFIXES = ("TcpQueueClient.", "TcpStreamReader.")
 

@@ -95,6 +95,7 @@ class _Peer:
         self.state = PEER_DOWN  # until the first successful pull
         self.host = ""
         self.pid = 0
+        self.worker = None  # queue-server worker id (ISSUE 17), or None
         self.profile = None  # last profile summary (ISSUE 16), or None
         self.last_pull_wall = 0.0
         self.last_error = ""
@@ -150,7 +151,8 @@ class PeerState:
     """Read-model row for one peer (what the console renders)."""
 
     __slots__ = (
-        "label", "kind", "state", "host", "pid", "age_s", "error", "profile",
+        "label", "kind", "state", "host", "pid", "worker", "age_s", "error",
+        "profile",
     )
 
     def __init__(self, peer: _Peer, now: float):
@@ -159,6 +161,7 @@ class PeerState:
         self.state = peer.state
         self.host = peer.host
         self.pid = peer.pid
+        self.worker = peer.worker
         self.profile = peer.profile
         self.age_s = (now - peer.last_pull_wall) if peer.last_pull_wall else -1.0
         self.error = peer.last_error
@@ -234,6 +237,11 @@ class ClusterCollector:
                     )
                     peer.host = payload.get("host", peer.host) or peer.host
                     peer.pid = int(payload.get("pid", peer.pid) or 0)
+                    # worker tag (ISSUE 17): this peer's pinned TCP
+                    # connection always answers from the same forked
+                    # worker, so the tag is stable per peer
+                    w = payload.get("worker")
+                    peer.worker = int(w) if w is not None else None
                     prof = payload.get("profile")
                     peer.profile = prof if isinstance(prof, dict) else None
                     peer.last_pull_wall = now

@@ -134,6 +134,12 @@ def peer_row(label: str, state, store, window_s: float = 30.0,
         "lag": store.last("replication.lag_records"),
         "spark": sparkline(spark_vals),
         "cpu_pct": None if cpu_frac is None else 100.0 * cpu_frac,
+        # evloop duty cycle (ISSUE 17): with --workers each peer row is
+        # one worker, so this is the per-worker saturation signal the
+        # scaling runbook reads ("which worker is pegged?")
+        "busy_pct": None if (b := store.last("evloop.busy_frac_ewma")
+                             or store.last("evloop.busy_frac")) is None
+        else 100.0 * b,
         "hot": hot,
     }
 
@@ -152,20 +158,25 @@ def render(collector: ClusterCollector, window_s: float = 30.0,
         f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(now))}",
         f"{'PEER':<28} {'ST':<9} {'HOST:PID':<18} {'FPS':>9} "
         f"{'DEPTH':>7} {'CREDIT':>7} {'RATIO':>6} {'SHED/s':>7} "
-        f"{'LAG':>6} {'CPU%':>5}  FPS HISTORY",
+        f"{'LAG':>6} {'CPU%':>5} {'BUSY%':>5}  FPS HISTORY",
     ]
     for p in sorted(peers, key=lambda p: p.label):
         store = collector.store(p.label)
         row = peer_row(p.label, p.state, store, window_s,
                        profile=getattr(p, "profile", None))
         hostpid = f"{p.host}:{p.pid}" if p.host else "-"
+        # a --workers peer identifies its worker (ISSUE 17): the pulled
+        # connection pins to one worker, so the tag is row-stable
+        wid = getattr(p, "worker", None)
+        if wid is not None:
+            hostpid += f"/w{wid}"
         hot = f"  hot: {row['hot']}" if row["hot"] else ""
         lines.append(
             f"{row['label']:<28.28} {row['state']:<9} {hostpid:<18.18} "
             f"{_fmt(row['fps']):>9} {_fmt(row['depth'], 0):>7} "
             f"{_fmt(row['credit'], 0):>7} {_fmt(row['ratio'], 2):>6} "
             f"{_fmt(row['shed_rate']):>7} {_fmt(row['lag'], 0):>6} "
-            f"{_fmt(row['cpu_pct'], 0):>5}  "
+            f"{_fmt(row['cpu_pct'], 0):>5} {_fmt(row['busy_pct'], 0):>5}  "
             f"{row['spark']}{hot}"
         )
         if p.state != PEER_UP and p.error:

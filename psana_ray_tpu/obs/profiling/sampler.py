@@ -348,6 +348,33 @@ class FlameSampler:
             except Exception:
                 pass
 
+    def rearm_after_fork(self, process: Optional[str] = None) -> "FlameSampler":
+        """Make a sampler inherited across ``os.fork`` valid in the CHILD.
+
+        Fork clones neither the sampler thread nor the procfs task
+        directory: ``self._thread`` points at a thread that does not
+        exist here, and every cached ``/proc/self/task/<tid>/stat`` fd
+        in ``self._threads`` describes the PARENT's threads (procfs
+        fds stay readable post-fork — they would silently misattribute
+        CPU). Reset both and restart. ``queue_server --workers`` forks
+        BEFORE any sampler starts, so its workers never need this; it
+        exists for embedders that fork with a live profiler, and
+        ``process`` lets the child rename its spool (e.g. a worker id)
+        so prof_merge shows it as its own process row."""
+        self._thread = None  # the parent's thread; not ours to join
+        self._stop.clear()
+        for info in self._threads.values():
+            if info[0] >= 0:
+                try:
+                    os.close(info[0])
+                except OSError:
+                    pass
+        self._threads.clear()
+        self._registered = False  # the child's registry is a fresh copy
+        if process:
+            self.process = process
+        return self.start()
+
     # ---- sampling loop (hot: lint-guarded) ----
 
     def _run(self):  # lint: sample-path

@@ -195,6 +195,18 @@ def federation_payload(registry: Optional[MetricsRegistry] = None) -> dict:
         "mono": time.monotonic(),
         "metrics": reg.snapshot(),
     }
+    # multi-worker data plane (ISSUE 17): a forked queue-server worker
+    # tags its payload so the collector/console can label per-worker
+    # rows (a pulled TCP connection pins to ONE worker for its life,
+    # so each peer's series is per-worker consistent)
+    try:
+        from psana_ray_tpu.transport.workers import current_worker_id
+
+        wid = current_worker_id()
+        if wid is not None:
+            payload["worker"] = wid
+    except Exception:
+        pass
     # continuous-profiler summary (ISSUE 16) rides OUTSIDE "metrics":
     # hot-frame NAMES are strings and flatten_numeric would drop them.
     # Absent/broken profiler must cost nothing — peers render "-".

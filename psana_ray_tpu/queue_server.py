@@ -10,6 +10,13 @@ get-or-creates the queue server-side — one server serves every detector's
 stream. Named queues are detached: they outlive the clients that created
 them, until this process stops.
 
+``--workers N`` (ISSUE 17) breaks the single-core ceiling: N forked
+evloop processes share the ONE listening port via ``SO_REUSEPORT``, each
+named queue rendezvous-pinned to exactly one worker, connections shipped
+between workers over ``SCM_RIGHTS`` when the kernel's connection
+sharding disagrees with the queue pinning. The client contract is
+unchanged — one address, same ordering, same redelivery.
+
 Optionally backed by a shared-memory ring (``--shm``) so local processes on
 the serving host can bypass TCP entirely while remote ones fan in/out over
 the network.
@@ -34,6 +41,21 @@ def main(argv=None):
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=6379, help="reference head-node port")
     p.add_argument("--queue_size", type=int, default=100)
+    p.add_argument(
+        "--workers",
+        type=int,
+        default=1,
+        help=(
+            "fork this many evloop server processes sharing ONE port via "
+            "SO_REUSEPORT (ISSUE 17): each named queue lives on exactly "
+            "one worker (rendezvous-pinned, respawn-stable), connections "
+            "migrate between workers over SCM_RIGHTS when the kernel's "
+            "accept sharding disagrees with the pinning, and a crashed "
+            "worker is respawned with its queues recovered from the "
+            "durable log. Clients see one address and the unchanged "
+            "contract. Incompatible with --shm and --replicate_peers"
+        ),
+    )
     p.add_argument(
         "--shm",
         default=None,
@@ -187,13 +209,6 @@ def main(argv=None):
         format="%(asctime)s - %(levelname)s - %(message)s",
     )
 
-    from psana_ray_tpu.obs import MetricsRegistry, StallDetector, start_metrics_server
-    from psana_ray_tpu.transport.ring import RingBuffer
-    from psana_ray_tpu.transport.tcp import TcpQueueServer
-
-    queue_factory = None
-    group_store_path = None
-    replication = None
     if a.durable_dir and a.shm:
         p.error("--durable_dir and --shm are mutually exclusive (the "
                 "segment log backs in-process queues; shm rings have "
@@ -210,13 +225,107 @@ def main(argv=None):
             p.error(f"--advertise {a.advertise!r} does not appear in "
                     f"--replicate_peers {_peers} — the spellings must "
                     f"match exactly or no queue will ever replicate")
+    if a.workers > 1:
+        import socket as _socket
+
+        if not hasattr(_socket, "SO_REUSEPORT"):
+            p.error("--workers needs SO_REUSEPORT, which this platform "
+                    "does not expose — run a single worker")
+        if a.shm:
+            p.error("--workers is incompatible with --shm (shm rings "
+                    "already give local processes multi-process access; "
+                    "pick one data plane)")
+        if a.replicate_peers:
+            p.error("--workers is incompatible with --replicate_peers "
+                    "(replica links bind queues directly to one serving "
+                    "process; run replicated servers single-worker)")
+        return _run_workers(a, dur_defaults)
+    return _serve(a, dur_defaults)
+
+
+def _run_workers(a, dur_defaults) -> int:
+    """The parent of a ``--workers N`` fleet: resolve the shared port,
+    fork N workers (each builds its full server in :func:`_serve`),
+    respawn the dead, forward shutdown. The parent itself serves
+    nothing — it is pure supervision, and it forks BEFORE starting any
+    thread so no lock is ever cloned mid-hold."""
+    import os
+    import tempfile
+
+    from psana_ray_tpu.transport.splice import probe_report
+    from psana_ray_tpu.transport.workers import (
+        WorkerContext,
+        WorkerSupervisor,
+        resolve_port,
+    )
+
+    port = resolve_port(a.host, a.port)
+    sock_dir = tempfile.mkdtemp(prefix="psana-workers-")
+
+    def _worker_entry(worker_id):
+        ctx = WorkerContext(worker_id, a.workers, sock_dir)
+        _serve(a, dur_defaults, worker_ctx=ctx, port=port)
+
+    sup = WorkerSupervisor(a.workers, _worker_entry).start()
+    if a.port_file:
+        with open(a.port_file + ".tmp", "w") as f:
+            f.write(str(port))
+        os.replace(a.port_file + ".tmp", a.port_file)  # atomic: no torn read
+    logger.info(
+        "queue server: %d workers sharing %s:%d via SO_REUSEPORT "
+        "(rendezvous-pinned queues, SCM_RIGHTS migration, respawn on "
+        "death; kernel pass-through probe: %s) — clients use "
+        "--address tcp://<host>:%d exactly as with one worker",
+        a.workers, a.host, port, probe_report(), port,
+    )
+
+    done = threading.Event()
+
+    def _stop(sig, frame):
+        logger.info("signal %s — shutting down worker fleet", sig)
+        done.set()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    done.wait()
+    # each worker runs its own graceful drain inside its SIGTERM handler
+    sup.stop(timeout_s=a.drain_s + 10.0)
+    return 0
+
+
+def _serve(a, dur_defaults, worker_ctx=None, port=None) -> int:
+    """One full queue-server process: backing, TCP server, obs plane,
+    autotune, signal-driven drain. With ``worker_ctx`` this is one
+    worker of a ``--workers`` fleet: it reuseport-binds the shared
+    port, owns only its rendezvous partitions, and tags its telemetry
+    with the worker id."""
+    from psana_ray_tpu.obs import MetricsRegistry, StallDetector, start_metrics_server
+    from psana_ray_tpu.transport.ring import RingBuffer
+    from psana_ray_tpu.transport.tcp import TcpQueueServer
+
+    wid = worker_ctx.worker_id if worker_ctx is not None else None
+    owns_default = worker_ctx is None or wid == worker_ctx.default_owner
+    queue_factory = None
+    group_store_path = None
+    replication = None
+    # late-bound autotune registry hook: named queues open AFTER the
+    # daemon starts, and each durable one registers its own dials
+    tune_box = {"daemon": None}
     if a.durable_dir:
         import os
 
+        from psana_ray_tpu.autotune.knobs import fsync_batch_knob, ram_items_knob
         from psana_ray_tpu.storage import DurableRingBuffer, SegmentLog
 
         os.makedirs(a.durable_dir, exist_ok=True)
-        group_store_path = os.path.join(a.durable_dir, "groups.json")
+        # per-worker coordinator state: a queue's consumer groups are
+        # only ever touched by its owning worker (ops route there), so
+        # per-worker files never race; keep --workers N stable across
+        # restarts or group progress stays in the old owner's file
+        group_store_path = os.path.join(
+            a.durable_dir,
+            "groups.json" if wid is None else f"groups-w{wid}.json",
+        )
 
         def _durable_backing(ns, name, maxsize):
             # one log directory per named queue; the boot-time recovery
@@ -233,6 +342,9 @@ def main(argv=None):
             q = DurableRingBuffer(
                 log, maxsize=maxsize, name=f"{ns}__{name}",
                 ram_items=a.ram_items or None,
+                # spill reads resolve lazily so the evloop can splice
+                # the on-disk payload straight to the socket (ISSUE 17)
+                lazy_spill=True,
             )
             depth = q.size()
             if depth:
@@ -242,10 +354,37 @@ def main(argv=None):
                     ns, name, depth, qdir, log.committed(""),
                     ", TORN TAIL repaired" if log.torn_tail_repaired else "",
                 )
+            daemon = tune_box["daemon"]
+            if daemon is not None and (ns, name) != ("default", "default"):
+                # per-named-queue dials (ISSUE 17): each durable log
+                # tunes fsync batching and spill threshold to ITS
+                # producer, suffixed so names never collide
+                reg = daemon.controller.registry
+                try:
+                    reg.register(
+                        fsync_batch_knob(log, name=f"fsync_batch_n:{ns}/{name}"),
+                        "--fsync_batch_n set explicitly"
+                        if a.fsync_batch_n != dur_defaults.fsync_batch_n
+                        else None,
+                    )
+                    reg.register(
+                        ram_items_knob(q, name=f"ram_items:{ns}/{name}"),
+                        "--ram_items set explicitly"
+                        if a.ram_items != dur_defaults.ram_items
+                        else None,
+                    )
+                except ValueError:
+                    pass  # same name re-opened in-process: dials exist
             return q
 
         queue_factory = _durable_backing
-        backing = _durable_backing("default", "default", a.queue_size)
+        if owns_default:
+            backing = _durable_backing("default", "default", a.queue_size)
+        else:
+            # this worker never serves the default queue (ops on it
+            # migrate to its owner); a plain ring satisfies the server
+            # ctor without touching the owner's log directory
+            backing = RingBuffer(a.queue_size)
         logger.info(
             "backing queues: segment logs under %s (segment_bytes=%d, "
             "retain=%d, fsync=%s)",
@@ -294,26 +433,36 @@ def main(argv=None):
         backing = RingBuffer(a.queue_size)
 
     server = TcpQueueServer(
-        backing, host=a.host, port=a.port, maxsize=a.queue_size,
+        backing, host=a.host, port=port if port is not None else a.port,
+        maxsize=a.queue_size,
         queue_factory=queue_factory, max_conns=a.max_conns,
         group_store_path=group_store_path, replication=replication,
+        reuseport=worker_ctx is not None, worker_ctx=worker_ctx,
     ).serve_background()
-    if a.port_file:
+    if a.port_file and worker_ctx is None:  # fleet parent already wrote it
         with open(a.port_file + ".tmp", "w") as f:
             f.write(str(server.port))
         import os as _os
 
         _os.replace(a.port_file + ".tmp", a.port_file)  # atomic: no torn read
-    logger.info(
-        "queue server listening on %s:%d (size=%d%s) — clients use "
-        "--address tcp://<host>:%d, or start N of these and point "
-        "clients at --cluster host:port,host:port (sharded queue "
-        "service; the legacy thread-per-connection --server_mode was "
-        "removed, the epoll event loop is THE server)",
-        a.host, server.port, a.queue_size,
-        f", max_conns={a.max_conns}" if a.max_conns else "",
-        server.port,
-    )
+    if worker_ctx is not None:
+        from psana_ray_tpu.transport.splice import probe_report
+
+        logger.info(
+            "worker %d/%d listening on %s:%d (splice: %s)",
+            wid, worker_ctx.n_workers, a.host, server.port, probe_report(),
+        )
+    else:
+        logger.info(
+            "queue server listening on %s:%d (size=%d%s) — clients use "
+            "--address tcp://<host>:%d, or start N of these and point "
+            "clients at --cluster host:port,host:port (sharded queue "
+            "service; the legacy thread-per-connection --server_mode was "
+            "removed, the epoll event loop is THE server)",
+            a.host, server.port, a.queue_size,
+            f", max_conns={a.max_conns}" if a.max_conns else "",
+            server.port,
+        )
 
     # Observability: every queue (default + OPENed named ones) as a
     # registry source, the Prometheus endpoint over it, and the stall
@@ -323,7 +472,13 @@ def main(argv=None):
     # payload-copy counters under `wire` — the zero-copy datapath's
     # steady state is visible on the same endpoint.
     MetricsRegistry.default().register("queue_server", server.stats_all)
-    metrics_server = start_metrics_server(a.metrics_port, host=a.metrics_host)
+    # a worker fleet staggers the scrape endpoints: worker i serves
+    # --metrics_port + i (one process cannot answer for its siblings;
+    # the federation collector aggregates per-worker series instead)
+    metrics_port = a.metrics_port
+    if metrics_port and wid is not None:
+        metrics_port += wid
+    metrics_server = start_metrics_server(metrics_port, host=a.metrics_host)
     # Time-series history (ISSUE 13): the bounded per-key snapshot ring
     # behind flight-dump tails and the federation collector's 'N'
     # metrics RPC (this server answers it regardless; the sampler adds
@@ -333,14 +488,19 @@ def main(argv=None):
 
     history = configure_history_from_args(a)
     # continuous profiler (ISSUE 16): bills the event loop's dispatch
-    # pass to the "dispatch" stage; --profile_hz 0 = off
-    profiler = configure_profiling_from_args(a, "queue_server")
+    # pass to the "dispatch" stage; --profile_hz 0 = off. Workers spool
+    # under distinct process names so prof_merge shows per-worker rows.
+    profiler = configure_profiling_from_args(
+        a, "queue_server" if wid is None else f"queue_server-w{wid}"
+    )
     # Tracing (relay spans: queue_dwell/relay per sampled frame) and the
     # flight recorder (dump-on-stall/SIGUSR2/exception — the black box for
     # wedged runs) arm from the shared --trace_dir/--flight_dir flags.
     from psana_ray_tpu.obs import FLIGHT, configure_tracing_from_args
 
-    configure_tracing_from_args(a, "queue_server")
+    configure_tracing_from_args(
+        a, "queue_server" if wid is None else f"queue_server-w{wid}"
+    )
     stall = None
     if a.stall_poll_s > 0:
         stall = StallDetector(
@@ -356,11 +516,13 @@ def main(argv=None):
         stall.start()
 
     # autotune (ISSUE 15): server-side knobs — fsync batching and the
-    # RAM spill threshold on the default durable queue, plus the relay
-    # recv-pool retention floor — judged by the measured relay rate
-    # (gets/s on the default queue). Explicitly-set flags pin their
-    # knobs: the operator's value is a decision, not a default (a flag
-    # passed AT its default value reads as unset — documented).
+    # RAM spill threshold on the default durable queue (plus one dial
+    # pair PER NAMED durable queue as they open), the relay recv-pool
+    # retention floor, and the recommendation-only data-plane width —
+    # judged by the measured relay rate (gets/s on the default queue).
+    # Explicitly-set flags pin their knobs: the operator's value is a
+    # decision, not a default (a flag passed AT its default value reads
+    # as unset — documented).
     autotune = None
     if a.autotune != "off":
         from psana_ray_tpu.autotune import Objective, configure_autotune_from_args
@@ -368,12 +530,19 @@ def main(argv=None):
             bufpool_retention_knob,
             fsync_batch_knob,
             ram_items_knob,
+            workers_knob,
         )
         from psana_ray_tpu.utils.bufpool import BufferPool
 
-        knobs = [bufpool_retention_knob(BufferPool.default())]
+        knobs = [
+            bufpool_retention_knob(BufferPool.default()),
+            # declines on a single-core box; recommendation-only
+            workers_knob(current=a.workers),
+        ]
         pinned = {}
-        if a.durable_dir:
+        if a.workers > 1:
+            pinned["workers"] = "--workers set explicitly"
+        if a.durable_dir and getattr(backing, "log", None) is not None:
             knobs.append(fsync_batch_knob(backing.log))
             knobs.append(ram_items_knob(backing))
             if a.fsync_batch_n != dur_defaults.fsync_batch_n:
@@ -383,6 +552,7 @@ def main(argv=None):
         autotune = configure_autotune_from_args(
             a, knobs, Objective("queue_server.default.gets"), pinned=pinned
         )
+        tune_box["daemon"] = autotune
 
     done = threading.Event()
     force = threading.Event()
@@ -428,6 +598,8 @@ def main(argv=None):
         log = getattr(q, "log", None)
         if log is not None:  # durable backings: flush + unmap segments
             log.close()
+    if worker_ctx is not None:
+        worker_ctx.close()
     return 0
 
 

@@ -56,6 +56,47 @@ class _Entry:
         self.item = item
 
 
+class SpilledRecord:
+    """A delivered spilled record that has NOT been read into the
+    interpreter (``lazy_spill`` queues only).
+
+    The evloop server never interprets queue items — it frames and
+    relays them — so delivery can hand it this handle instead of the
+    decoded record: the kernel pass-through path asks
+    :meth:`payload_span` for a (file, pos, nbytes) sendfile span and
+    the payload bytes go mmap->socket without a Python copy;
+    :meth:`materialize` is the fallback (compressed connection, no
+    sendfile) and behaves exactly like the eager ``log.read``.
+
+    Identity-stable on purpose: every delivery contract in the server
+    is keyed by ``id(item)`` (``_outstanding``, ``_box_front`` requeue,
+    stream unacked tails, in-flight ack), and while this object is
+    outstanding the commit floor stays pinned at or below ``offset`` —
+    which is precisely what keeps the span's segment from being
+    recycled mid-send (see ``SegmentLog.payload_span``).
+    """
+
+    __slots__ = ("log", "offset", "_item")
+
+    def __init__(self, log: SegmentLog, offset: int):
+        self.log = log
+        self.offset = offset
+        self._item = None
+
+    def payload_span(self):
+        """``(file, file_pos, nbytes)`` of the raw tagged payload, or
+        None (offset no longer retained — caller materializes)."""
+        return self.log.payload_span(self.offset)
+
+    def materialize(self) -> Any:
+        """Decode the record (cached): the copying path, for consumers
+        that need the bytes in Python after all."""
+        if self._item is None:
+            DURABLE.spill_read()
+            self._item = self.log.read(self.offset)
+        return self._item
+
+
 class DurableRingBuffer(RingBuffer):
     def __init__(
         self,
@@ -64,11 +105,18 @@ class DurableRingBuffer(RingBuffer):
         name: str = "durable_queue",
         ram_items: Optional[int] = None,
         commit_on_get: bool = False,
+        lazy_spill: bool = False,
     ):
         super().__init__(maxsize=maxsize, name=name)
         self.log = log
         self.ram_items = int(ram_items) if ram_items else int(maxsize)
         self.commit_on_get = commit_on_get
+        # lazy_spill: deliver spilled entries as SpilledRecord handles
+        # instead of eagerly decoding (the evloop server's kernel
+        # pass-through). Only meaningful with ack-based commits: a
+        # commit-on-get consumer lets the floor pass the offset before
+        # the handle is read, so that mode stays eager.
+        self.lazy_spill = bool(lazy_spill) and not commit_on_get
         self._resident = 0  # RAM-held entries in _q  # guarded-by: _lock
         self._spilled = 0  # log-only entries in _q  # guarded-by: _lock
         # delivered-but-unacked: id(item) -> entry. Strong item refs on
@@ -139,8 +187,14 @@ class DurableRingBuffer(RingBuffer):
         # guarded-by-caller: _lock
         entry: _Entry = stored
         if entry.item is None:
-            DURABLE.spill_read()
-            entry.item = self.log.read(entry.offset)
+            if self.lazy_spill:
+                # no read, no copy: the handle carries the offset and
+                # the evloop moves the payload kernel-side (or
+                # materializes — which is when spill_read is counted)
+                entry.item = SpilledRecord(self.log, entry.offset)
+            else:
+                DURABLE.spill_read()
+                entry.item = self.log.read(entry.offset)
             self._spilled -= 1
             if self._spilled == 0:
                 FLIGHT.record("spill_exit", queue=self.name)

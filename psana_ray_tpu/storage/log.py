@@ -405,6 +405,28 @@ class SegmentLog:
             finally:
                 mv.release()
 
+    def payload_span(self, offset: int):
+        """The record's on-disk payload as a sendfile span: a
+        :class:`~psana_ray_tpu.transport.splice.FileSpan`-shaped tuple
+        ``(file, file_pos, nbytes)``, or None when the offset is not
+        retained. Unlike :meth:`read`, NOTHING is copied — the caller
+        (the evloop's kernel pass-through) moves the bytes file->socket
+        without the interpreter touching them. Safe only for a record
+        whose delivery pins the commit floor at or below ``offset``
+        (the durable queue's ``_outstanding`` contract): that pin is
+        what keeps ``_maybe_recycle`` from retiring the segment while
+        the span is queued. Replay cursors have no such pin and must
+        stay on the copying :meth:`read` path."""
+        with self._lock:
+            self._check_open()
+            seg = self._find_segment(offset)
+            if seg is None:
+                return None
+            pos = seg.find(offset)
+            if pos is None:
+                return None
+            return seg.payload_extent(pos)
+
     def _find_segment(self, offset: int) -> Optional[Segment]:
         # guarded-by-caller: _lock
         for seg in reversed(self._segments):

@@ -196,6 +196,18 @@ class Segment:
         start = pos + REC_OVERHEAD
         return self._mv[start : start + payload_len]
 
+    def payload_extent(self, pos: int):
+        """``(file, file_pos, nbytes)`` of the payload at ``pos`` — the
+        sendfile span for the kernel pass-through path (the on-disk
+        payload IS the raw tagged wire payload, written verbatim by
+        :meth:`append`). The file object is the segment's own open
+        handle; callers rely on the durable queue's commit-floor pin to
+        keep this segment live while the span is queued."""
+        magic, payload_len, _crc, _off = _REC_HEADER.unpack_from(self._mv, pos)
+        if magic != _SEG_REC_MAGIC:
+            raise ValueError(f"bad segment record magic {magic:#x} at {pos}")
+        return self._f, pos + REC_OVERHEAD, payload_len
+
     def find(self, offset: int) -> Optional[int]:
         """File position of the record with exactly ``offset``."""
         import bisect

@@ -83,8 +83,11 @@ def encode_payload_parts(item: Any) -> List[Any]:
 
 
 def payload_nbytes(parts: List[Any]) -> int:
-    """Total wire length of :func:`encode_payload_parts` output."""
-    return sum(p.nbytes if isinstance(p, memoryview) else len(p) for p in parts)
+    """Total wire length of :func:`encode_payload_parts` output. Any
+    part exposing ``.nbytes`` counts by it (memoryviews, and the splice
+    path's FileSpan — which has no ``len()`` because its bytes never
+    enter the interpreter); plain bytes count by ``len``."""
+    return sum(p.nbytes if hasattr(p, "nbytes") else len(p) for p in parts)
 
 
 def encode_payload(item: Any) -> bytes:

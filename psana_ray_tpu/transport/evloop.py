@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import heapq
 import json
+import os
 import selectors
 import socket
 import struct
@@ -79,7 +80,15 @@ from psana_ray_tpu.transport.codec import (
     negotiate_codec,
     payload_nbytes as _parts_nbytes,
 )
+from psana_ray_tpu.storage.durable import SpilledRecord
 from psana_ray_tpu.storage.log import COMMIT_DELIVERED
+from psana_ray_tpu.transport.splice import (
+    FileSpan,
+    SPLICE,
+    fallback_errno as _splice_fallback_errno,
+    sendfile_capable as _sendfile_capable,
+)
+from psana_ray_tpu.transport.workers import MIGRATE_GRACE_S, MIGRATE_RETRY_S
 from psana_ray_tpu.transport.tcp import (
     _MAX_PAYLOAD,
     _OP_ANCHOR,
@@ -368,7 +377,7 @@ class _EvConn:
         "_want_read", "_want_write", "_mask", "_sendmsg",
         "_qb_remaining", "_qb_items", "_pw_wait_s", "_w_seq",
         "_r_from", "_v_off", "_v_floor", "_open_ns", "_open_nm",
-        "_open_buf",
+        "_open_buf", "_no_splice", "_migration",
     )
 
     def __init__(self, loop: "EventLoop", sock: socket.socket, srv):
@@ -430,6 +439,14 @@ class _EvConn:
         self._open_ns = ""
         self._open_nm = ""
         self._open_buf = b""
+        # set when THIS socket refused os.sendfile (TLS wrapper, exotic
+        # family): spilled records materialize instead of queueing
+        # spans that would each fail at the pump
+        self._no_splice = False
+        # multi-worker handoff in progress (ISSUE 17): {"target",
+        # "ctx", "deadline"} while this connection waits to ship to the
+        # queue's owning worker — reads pause, queued bytes flush first
+        self._migration = None
 
     # -- read engine ------------------------------------------------------
     def _arm(self, mv: memoryview, cb, lease=None) -> None:
@@ -503,15 +520,35 @@ class _EvConn:
         """Queue parts for sending. ``release`` (a lease or list of
         leases backing compressed parts) is released once every byte
         queued SO FAR has left for the kernel — never while a queued
-        memoryview still references the lease's buffer."""
-        for m in _gather_parts(parts):
-            self.out.append(m)
-            self.out_bytes += m.nbytes
-            self._out_enq_total += m.nbytes
+        memoryview still references the lease's buffer.
+
+        A :class:`FileSpan` part (the kernel pass-through path) queues
+        AS ITSELF — it must not pass through ``_gather_parts``, which
+        would try to take a memoryview of it; byte runs between spans
+        still gather/coalesce as before."""
+        run: List[Any] = []
+        for p in parts:
+            if type(p) is FileSpan:
+                if run:
+                    self._enqueue_bufs(run)
+                    run = []
+                self.out.append(p)
+                self.out_bytes += p.nbytes
+                self._out_enq_total += p.nbytes
+            else:
+                run.append(p)
+        if run:
+            self._enqueue_bufs(run)
         if release is not None:
             for lease in release if isinstance(release, list) else (release,):
                 self._out_releases.append((self._out_enq_total, lease))
         self.flush_out()
+
+    def _enqueue_bufs(self, parts) -> None:
+        for m in _gather_parts(parts):
+            self.out.append(m)
+            self.out_bytes += m.nbytes
+            self._out_enq_total += m.nbytes
 
     def _send_control(self, b: bytes) -> None:
         self.send_parts([b])
@@ -521,9 +558,14 @@ class _EvConn:
             return
         try:
             while self.out:
+                if type(self.out[0]) is FileSpan:
+                    self._pump_span(self.out[0])
+                    continue
                 if self._sendmsg is not None:
                     bufs = []
                     for m in self.out:
+                        if type(m) is FileSpan:
+                            break  # spans splice alone, next loop pass
                         bufs.append(m)
                         if len(bufs) >= _SENDMSG_IOV:
                             break
@@ -550,7 +592,43 @@ class _EvConn:
         if not self.out and self.closing:
             self.loop.kill_conn(self, None, requeue=False)
             return
+        if not self.out and self._migration is not None:
+            # queued response bytes have fully left: the deferred
+            # worker handoff can ship the fd now
+            self.loop._try_migrate(self)
+            return
         self._set_interest(write=bool(self.out))
+
+    def _pump_span(self, span) -> None:
+        """Move the head FileSpan's bytes file->socket with
+        ``os.sendfile`` — the payload never enters the interpreter. On
+        a non-blocking socket sendfile returns short or raises
+        BlockingIOError (caught by flush_out, like a short sendmsg); a
+        can't-splice-here errno downgrades THIS span (and this
+        connection) to the sendmsg path by materializing the remaining
+        bytes in place — degrade, never die."""
+        try:
+            sent = os.sendfile(
+                self.sock.fileno(), span.fileno(), span.pos, span.nbytes
+            )
+        except (BlockingIOError, InterruptedError):
+            raise
+        except OSError as e:
+            if _splice_fallback_errno(e):
+                self._no_splice = True
+                SPLICE.note_fallback(f"sendfile_errno_{e.errno}")
+                self.out[0] = memoryview(span.materialize())
+                return
+            raise ConnectionError(f"sendfile failed: {e!r}") from e
+        if sent <= 0:
+            raise ConnectionError("peer closed during sendfile")
+        self.out_bytes -= sent
+        SPLICE.note_sendfile(sent)
+        if sent >= span.nbytes:
+            self.out.popleft()
+            SPLICE.note_frame()
+        else:
+            span.advance(sent)
 
     # -- selector interest ------------------------------------------------
     def _set_interest(self, read: Optional[bool] = None, write: Optional[bool] = None) -> None:
@@ -629,6 +707,22 @@ class _EvConn:
             raise ConnectionError(
                 f"bad opcode {op:#04x} on streamed connection"
             )
+        wctx = self.srv.worker_ctx
+        if (
+            wctx is not None
+            and self.queue is self.srv.queue
+            and wctx.worker_id != wctx.default_owner
+            and op not in _WORKER_LOCAL_OPS
+        ):
+            # this worker does not own the DEFAULT queue and the op
+            # touches it: ship the connection to the owner. Exactly one
+            # byte (the opcode) has been consumed — it rides in the
+            # context; anything the client pipelined behind it is still
+            # in the kernel socket buffer and travels with the fd.
+            self.loop.migrate_conn(
+                self, wctx.default_owner, {"kind": "op", "op": op}
+            )
+            return
         name = _OPS.get(op)
         if name is None:
             self._send_control(_ST_ERR)
@@ -650,7 +744,30 @@ class _EvConn:
         """codec.encode_for_wire under this connection's negotiated
         codec — the returned staging lease is handed to
         send_parts(release=...) so it outlives the queued bytes. See
-        the helper for the lease/pass-through contract."""
+        the helper for the lease/pass-through contract.
+
+        A :class:`SpilledRecord` (lazy durable spill, ISSUE 17) short-
+        circuits on an uncompressed connection: its on-disk payload IS
+        the raw wire payload, so the response becomes a FileSpan the
+        flush pump moves with sendfile — zero Python payload bytes.
+        Compressed connections (the span can't be compressed kernel-
+        side) and splice-refusing sockets materialize, which is exactly
+        the pre-ISSUE-17 eager spill read."""
+        if type(item) is SpilledRecord:
+            if (
+                self.codec is None
+                and not self._no_splice
+                and _sendfile_capable()
+            ):
+                span = item.payload_span()
+                if span is not None:
+                    f, pos, nbytes = span
+                    return [FileSpan(f, pos, nbytes)], None
+                # offset aged out of retention between unbox and send —
+                # can't happen while the floor pin holds, but degrade
+                # loudly rather than die if the contract ever breaks
+                SPLICE.note_fallback("span_unretained")
+            item = item.materialize()
         return _wire_encode(item, self.codec, self.srv._pool)
 
     def _respond_item(self, item) -> None:
@@ -1357,6 +1474,20 @@ class _EvConn:
 
     def _open_finish(self) -> None:
         (maxsize,) = struct.unpack_from("<I", self._hdr)
+        wctx = self.srv.worker_ctx
+        if wctx is not None:
+            owner = wctx.owner_of(self._open_ns, self._open_nm)
+            if owner != wctx.worker_id:
+                # the named queue's state lives on exactly one worker
+                # (rendezvous-pinned): ship the connection there; the
+                # adopter performs the open and answers the client
+                self.loop.migrate_conn(self, owner, {
+                    "kind": "open",
+                    "ns": self._open_ns,
+                    "nm": self._open_nm,
+                    "maxsize": maxsize,
+                })
+                return
         self.queue = self.srv.open_named(
             self._open_ns, self._open_nm, maxsize or None
         )
@@ -1387,6 +1518,21 @@ _OPS: Dict[int, str] = {
     _OP_BYE[0]: "_op_bye",
 }
 
+#: ops any worker serves LOCALLY even when it does not own the default
+#: queue: codec/tenant hello, cluster metadata + anchors (per-worker
+#: answers by design), replica-link setup (refused with --workers at the
+#: CLI), BYE. OPEN routes later, at _open_finish, once the name is read.
+#: Derived from the dispatch table by handler name so this set is not a
+#: second send-side reference to the opcode constants (the wire-protocol
+#: lint counts those as senders).
+_WORKER_LOCAL_OPS = frozenset(
+    op for op, handler in _OPS.items()
+    if handler in (
+        "_op_open", "_op_codec", "_op_cluster", "_op_anchor",
+        "_op_repl_open", "_op_promote", "_op_bye",
+    )
+)
+
 
 class EventLoop:
     """The one loop: accepts, reads, writes, fires bounded-wait timers
@@ -1409,6 +1555,7 @@ class EventLoop:
         self._waker_mv = memoryview(self._waker_buf)
         self._ACCEPT = object()
         self._WAKER = object()
+        self._ADOPT = object()
         self._loop_tid: Optional[int] = None
 
     # -- cross-thread pokes ----------------------------------------------
@@ -1556,12 +1703,19 @@ class EventLoop:
         srv = self._srv
         self._loop_tid = threading.get_ident()
         EVLOOP.ensure_registered()
+        SPLICE.ensure_registered()
         try:
             srv._sock.setblocking(False)
         except OSError:
             return  # shutdown() closed the socket before we got here
         self._sel.register(srv._sock, selectors.EVENT_READ, self._ACCEPT)
         self._sel.register(self._waker_r, selectors.EVENT_READ, self._WAKER)
+        if srv.worker_ctx is not None:
+            # the adoption socket: sibling workers ship connections
+            # whose queues this worker owns (ISSUE 17)
+            self._sel.register(
+                srv.worker_ctx.sock, selectors.EVENT_READ, self._ADOPT
+            )
         # stage-tag the dispatch half of each pass so the continuous
         # profiler bills server CPU to "dispatch" (bound once here: the
         # loop body must not pay an import)
@@ -1579,6 +1733,8 @@ class EventLoop:
                         self._accept()
                     elif data is self._WAKER:
                         self._drain_waker()
+                    elif data is self._ADOPT:
+                        self._adopt_conns()
                     else:
                         self._dispatch_conn(data, mask)
                 self._fire_timers()
@@ -1633,6 +1789,130 @@ class EventLoop:
             conn._await_op()
             conn._set_interest(read=True)
 
+    # -- multi-worker connection handoff (ISSUE 17) -----------------------
+    def migrate_conn(self, conn: _EvConn, target: int, ctx: dict) -> None:
+        """Begin shipping ``conn`` to worker ``target``: freeze reads,
+        flush any queued response bytes, then send the fd + context
+        over the adoption socket. The negotiated per-connection state
+        (codec, tenant) rides in the context so the adopter rebuilds an
+        indistinguishable connection."""
+        ctx = dict(ctx)
+        ctx["codec"] = conn.codec.name if conn.codec is not None else None
+        if conn.tenant != _TENANT_DEFAULT or conn.weight != 1:
+            ctx["tenant"] = conn.tenant
+            ctx["weight"] = conn.weight
+        conn._migration = {
+            "target": int(target),
+            "ctx": ctx,
+            "deadline": time.monotonic() + MIGRATE_GRACE_S,
+        }
+        conn._set_interest(read=False)
+        if conn.out:
+            conn._set_interest(write=True)  # flush_out ships when drained
+            return
+        self._try_migrate(conn)
+
+    def _try_migrate(self, conn: _EvConn) -> None:
+        """One handoff attempt. A refusal (owner's adoption buffer full,
+        owner mid-respawn) retries on a timer within the grace window;
+        past it the connection dies WITH redelivery — the client's
+        reconnect envelope plus durable re-expose make that lossless."""
+        if conn.closed or conn._migration is None:
+            return
+        mig = conn._migration
+        try:
+            self._srv.worker_ctx.send_conn(
+                mig["target"], conn.sock, mig["ctx"]
+            )
+        except OSError as e:
+            now = time.monotonic()
+            if now >= mig["deadline"]:
+                FLIGHT.record(
+                    "migrate_gave_up", target=mig["target"],
+                    err=e.__class__.__name__,
+                )
+                self.kill_conn(conn, e, requeue=True)
+                return
+            if not mig.get("retried"):
+                mig["retried"] = True
+                FLIGHT.record(
+                    "migrate_retry", target=mig["target"],
+                    err=e.__class__.__name__,
+                )
+            self._add_timer(now + MIGRATE_RETRY_S, conn, kind="migrate")
+            return
+        FLIGHT.record(
+            "conn_migrated", target=mig["target"],
+            kind=mig["ctx"].get("kind"),
+        )
+        # the in-flight datagram holds its own reference to the fd;
+        # closing our copy here is the normal no-redelivery teardown
+        # (nothing is in flight at a migration point by construction)
+        conn._migration = None
+        self.kill_conn(conn, None, requeue=False)
+
+    def _adopt_conns(self) -> None:
+        """Drain the adoption socket: each datagram is a connection fd
+        plus its context from a sibling worker. Rebuild the _EvConn
+        exactly as _accept would, restore negotiated state, then either
+        finish the routed OPEN or replay the consumed opcode byte."""
+        srv = self._srv
+        wctx = srv.worker_ctx
+        for sock, ctx in wctx.recv_conns():
+            conn = None
+            try:
+                sock.setblocking(False)
+                try:
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+                conn = _EvConn(self, sock, srv)
+                name = ctx.get("codec")
+                if name:
+                    conn.codec = negotiate_codec([name])
+                conn.tenant = ctx.get("tenant", _TENANT_DEFAULT)
+                try:
+                    conn.weight = max(1, int(ctx.get("weight", 1)))
+                except (TypeError, ValueError):
+                    conn.weight = 1
+                self._conns.add(conn)
+                with srv._conns_lock:  # shutdown() parity sweep
+                    srv._conns = [c for c in srv._conns if c.fileno() != -1]
+                    srv._conns.append(sock)
+                EVLOOP.conn_opened()
+                FLIGHT.record(
+                    "conn_adopted", worker=wctx.worker_id,
+                    kind=ctx.get("kind"),
+                )
+                if ctx.get("kind") == "open":
+                    conn._open_ns = ctx.get("ns", "")
+                    conn._open_nm = ctx.get("nm", "")
+                    conn.queue = srv.open_named(
+                        conn._open_ns, conn._open_nm,
+                        ctx.get("maxsize") or None,
+                    )
+                    conn._send_control(_ST_OK)
+                    conn._await_op()
+                else:
+                    # the migrating worker consumed exactly the opcode
+                    # byte: replay it through the normal dispatcher (we
+                    # own the target queue, so it cannot re-route)
+                    conn._hdr[0] = int(ctx.get("op", 0))
+                    conn._on_op()
+                if not conn.closed:
+                    conn._set_interest(read=True)
+            except (ConnectionError, OSError) as e:
+                if conn is not None:
+                    self.kill_conn(conn, e)
+                else:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            except Exception as e:  # noqa: BLE001 — one bad adoption must not kill the loop
+                if conn is not None:
+                    self.kill_conn(conn, e)
+
     def _drain_waker(self) -> None:
         while True:
             try:
@@ -1666,7 +1946,16 @@ class EventLoop:
         now = time.monotonic()
         while self._timers and self._timers[0][0] <= now:
             deadline, _tie, conn, gen, tkind = heapq.heappop(self._timers)
-            if conn.closed or conn.pending is None or gen != conn.op_gen:
+            if conn.closed:
+                continue
+            if tkind == "migrate":
+                # worker-handoff retry: independent of pending/op_gen
+                # (a migrating connection has neither) — must be
+                # checked BEFORE the pending-is-None guard below
+                if conn._migration is not None and not conn.out:
+                    self._try_migrate(conn)
+                continue
+            if conn.pending is None or gen != conn.op_gen:
                 continue  # already served / superseded
             if tkind == "probe":
                 # parked with reads paused: re-arm read interest so the

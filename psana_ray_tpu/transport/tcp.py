@@ -640,8 +640,16 @@ class TcpQueueServer:
         max_conns: int = 0,
         group_store_path: Optional[str] = None,
         replication=None,
+        reuseport: bool = False,
+        worker_ctx=None,
     ):
         self.queue = queue if queue is not None else RingBuffer(maxsize)
+        # multi-process data plane (ISSUE 17): a transport.workers.
+        # WorkerContext makes this server ONE of N forked evloop workers
+        # sharing the port via SO_REUSEPORT — the loop registers its
+        # adoption socket and routes queue ops to partition owners over
+        # SCM_RIGHTS fd migration. None = classic single-process server.
+        self.worker_ctx = worker_ctx
         self._maxsize = maxsize
         # recv-buffer pool for the relay path: every PUT payload lands in
         # a recycled lease and is decoded zero-copy, so a brokered frame
@@ -658,6 +666,12 @@ class TcpQueueServer:
         self._queues_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuseport:
+            # N worker processes each bind their own listener to the
+            # SAME port; the kernel shards incoming CONNECTIONS across
+            # them (queue partitioning is the workers' fd-migration
+            # job, not the kernel's)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
@@ -1387,6 +1401,14 @@ class TcpQueueClient:
                     FLIGHT.record(
                         "stream_resubscribe", host=self.host, port=self.port
                     )
+                # the clipped dial timeout bounded THIS handshake; the
+                # connection it produced must run under the configured
+                # timeout, or every later server-side blocking wait
+                # (opcode 'D' parks up to the caller's own deadline)
+                # outlives the poisoned recv timeout and reads as a
+                # fresh death — reconnect storm, then TransportClosed
+                # on a perfectly healthy server
+                self._sock.settimeout(self._timeout_s)
                 return
             except (ConnectionError, socket.timeout, OSError) as e:
                 last = e

@@ -1,0 +1,37 @@
+"""Known-bad fixture for event-loop-blocking rooted at the ISSUE 17
+additions: the kernel pass-through pump (``_EvConn._pump_span``) and the
+worker supervisor loop (``WorkerSupervisor._supervise``) are audited
+roots of their own — blocking idioms inside them must flag even when
+nothing links them back to ``EventLoop.run``."""
+
+import os
+import time
+
+
+class _EvConn:
+    def _pump_span(self, span):
+        # BAD: a retry sleep inside the splice pump freezes every
+        # connection on the loop — sendfile must return short or raise
+        # BlockingIOError, never be waited for
+        while True:
+            try:
+                sent = os.sendfile(
+                    self.sock.fileno(), span.fileno(), span.pos, span.nbytes
+                )
+                break
+            except BlockingIOError:
+                time.sleep(0.001)  # BAD: busy-wait on the loop thread
+        self._ack_reader.join()  # BAD: unbounded join in the pump
+        return sent
+
+
+class WorkerSupervisor:
+    def _supervise(self):
+        while True:
+            pid, status = os.waitpid(-1, 0)
+            time.sleep(1.0)  # BAD: respawn backoff held on the reap loop
+            self._lock.acquire()  # BAD: lock wait with no timeout
+            self._respawn(pid)
+
+    def _respawn(self, pid):
+        self._spawn_thread.join()  # BAD: unbounded join before respawn

@@ -1,0 +1,38 @@
+"""Known-good fixture for event-loop-blocking at the ISSUE 17 roots:
+the sanctioned splice-pump and supervisor shapes — one non-blocking
+sendfile per pump call (short returns and BlockingIOError are the
+flow control), and a reap loop whose only park is ``os.waitpid``
+(event-driven reaping) with every other wait deadline-bounded."""
+
+import os
+
+
+class _EvConn:
+    def _pump_span(self, span):
+        # one attempt per readiness event: a short send advances the
+        # span in place, EAGAIN propagates to flush_out, which keeps
+        # EPOLLOUT armed — the selector drives the retry, not a wait
+        sent = os.sendfile(
+            self.sock.fileno(), span.fileno(), span.pos, span.nbytes
+        )
+        if sent < span.nbytes:
+            span.advance(sent)
+        else:
+            self.out.popleft()
+        return sent
+
+
+class WorkerSupervisor:
+    def _supervise(self):
+        while True:
+            try:
+                pid, status = os.waitpid(-1, 0)  # parked reaping, not sleeping
+            except ChildProcessError:
+                if self._stop.wait(0.2):  # bounded: shutdown poll slice
+                    return
+                continue
+            self._respawn(pid)
+
+    def _respawn(self, pid):
+        if self._spawn_thread is not None:
+            self._spawn_thread.join(timeout=2.0)  # bounded join

@@ -262,10 +262,15 @@ class TestWiring:
 
     def test_queue_server_wires_stall_dumps(self):
         # the CLI passes FLIGHT.on_stall into its StallDetector — pin the
-        # wiring so a refactor can't silently drop the black box
+        # wiring so a refactor can't silently drop the black box. The
+        # serve body lives in _serve (main dispatches to it directly or
+        # per worker via --workers), so inspect the module, not main
         import inspect
 
         import psana_ray_tpu.queue_server as qs
 
-        src = inspect.getsource(qs.main)
+        src = inspect.getsource(qs)
         assert "on_event=FLIGHT.on_stall" in src
+        # and the wiring sits on the path every worker runs, not in a
+        # single-process-only branch: _serve is the shared serve body
+        assert "on_event=FLIGHT.on_stall" in inspect.getsource(qs._serve)

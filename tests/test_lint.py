@@ -185,6 +185,30 @@ def test_blocking_roots_rot_is_a_finding():
     ), result.findings
 
 
+def test_splice_pump_and_supervisor_are_audited_roots():
+    """ISSUE 17: the kernel pass-through pump and the worker supervisor
+    loop are event-loop-blocking roots of their own. The dedicated
+    fixture pair proves both directions WITHOUT an EventLoop.run in
+    scope — if either root rots out of ROOTS, the bad fixture stops
+    flagging and this test fails."""
+    bad = FIXTURES / "splice_pump_bad.py"
+    result = run_lint(
+        paths=[bad], checkers=["event-loop-blocking"], use_allowlist=False
+    )
+    mine = [f for f in result.findings if f.checker == "event-loop-blocking"]
+    assert mine, "splice pump / supervisor blocking idioms did not flag"
+    # both roots must contribute findings, not just one
+    msgs = "\n".join(f.message for f in mine)
+    assert "_pump_span" in msgs, msgs
+    assert "_supervise" in msgs or "WorkerSupervisor" in msgs, msgs
+    good = FIXTURES / "splice_pump_good.py"
+    result = run_lint(
+        paths=[good], checkers=["event-loop-blocking"], use_allowlist=False
+    )
+    mine = [f for f in result.findings if f.checker == "event-loop-blocking"]
+    assert not mine, "\n".join(f.render() for f in mine)
+
+
 def test_unattached_guarded_by_annotation_is_a_finding():
     import textwrap
 

@@ -1,0 +1,88 @@
+"""Standalone relay load driver for the bench ``data-plane`` section.
+
+One PROCESS per invocation — the worker-scaling A/B measures the
+SERVER's multi-core data plane, so the client load must not serialize
+on a single bench-process GIL. Each named queue gets a producer thread
+(windowed pipelined puts) and a consumer thread (batched gets) against
+``127.0.0.1:<port>``; the script prints ``<frames_relayed> <wall_s>``
+on stdout and exits nonzero if any queue came up short.
+
+Usage: relay_driver.py <port> <n_per_queue> <q1,q2,...> <HxWxD>
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+# invoked by script path, so sys.path[0] is tools/ — the package lives
+# one level up (the repo is run in place, not installed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from psana_ray_tpu.records import FrameRecord  # noqa: E402
+from psana_ray_tpu.transport.tcp import TcpQueueClient  # noqa: E402
+
+
+def pump(port, qname, n, panels, results):
+    prod = TcpQueueClient(
+        "127.0.0.1", port, namespace="bench", queue_name=qname,
+    )
+    cons = TcpQueueClient(
+        "127.0.0.1", port, namespace="bench", queue_name=qname,
+    )
+
+    def produce():
+        for i in range(n):
+            if not prod.put_pipelined(
+                FrameRecord(0, i, panels, 9.5),
+                deadline=time.monotonic() + 300,
+            ):
+                raise RuntimeError(f"{qname}: producer starved out")
+        if not prod.flush_puts(deadline=time.monotonic() + 300):
+            raise RuntimeError(f"{qname}: put window never drained")
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    seen = 0
+    deadline = time.monotonic() + 300
+    while seen < n and time.monotonic() < deadline:
+        batch = cons.get_batch(32, timeout=10.0)
+        if not batch:
+            continue
+        seen += len(batch)
+    t.join(timeout=30)
+    results[qname] = seen
+    prod.disconnect()
+    cons.disconnect()
+
+
+def main():
+    port = int(sys.argv[1])
+    n = int(sys.argv[2])
+    queues = sys.argv[3].split(",")
+    shape = tuple(int(x) for x in sys.argv[4].split("x"))
+    rng = np.random.default_rng(7)
+    panels = rng.integers(0, 4096, size=shape, dtype=np.uint16)
+
+    results = {}
+    threads = [
+        threading.Thread(
+            target=pump, args=(port, q, n, panels, results), daemon=True
+        )
+        for q in queues
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    total = sum(results.values())
+    print(f"{total} {dt:.6f}")
+    return 0 if total == n * len(queues) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,15 @@ _BASELINE_RULES = (
      or k.endswith("precision"), "higher", 0.0, 0.02),
     ("lost_frames", lambda k: k.endswith("_lost") or k.endswith(".lost"),
      "lower", 0.0, 0.0),
+    # model-checker counterexamples (ISSUE 18): ZERO tolerance, zero
+    # floor — a single counterexample is a protocol bug, not noise.
+    # exhausted_all rides the same gate via the bool-as-0/1 grammar
+    # ("higher", so a truncated fleet reads as a regression too).
+    ("model_counterexamples",
+     lambda k: k.endswith("lint.model.counterexamples"),
+     "lower", 0.0, 0.0),
+    ("model_exhausted", lambda k: k.endswith("lint.model.exhausted_all"),
+     "higher", 0.0, 0.0),
 )
 
 
@@ -724,6 +733,21 @@ def main(argv=None):
             },
             "files_scanned": _lint.files_scanned,
             "duration_s": round(_lint.duration_s, 3),
+        }
+        # the ISSUE 18 model checker at FULL profile (the registry entry
+        # above only runs the quick profile): state-space size and wall
+        # time ride the trajectory, and counterexamples is baseline-gated
+        # at ZERO tolerance — one counterexample is a protocol bug
+        from psana_ray_tpu.lint.model import run_models
+
+        _mc = run_models("full")
+        extras["lint"]["model"] = {
+            "states": sum(r.states for r in _mc),
+            "transitions": sum(r.transitions for r in _mc),
+            "max_depth": max(r.max_depth for r in _mc),
+            "counterexamples": sum(1 for r in _mc if r.violation is not None),
+            "exhausted_all": all(r.exhausted for r in _mc),
+            "duration_s": round(sum(r.duration_s for r in _mc), 3),
         }
     except Exception as e:  # noqa: BLE001 — lint must never kill the bench
         extras["lint"] = {"error": repr(e)}

@@ -863,6 +863,9 @@ class ReplicationManager:
         follower = next_in_chain(self.peers, self.advertise, base, part)
         if follower is None or follower == self.advertise:
             return
+        # lock-order: ReplicationManager._lock -> ReplicationSender._lock
+        # (the ctor primes the sender under its own lock; nothing in the
+        # sender ever calls back into the manager while holding it)
         with self._lock:
             if self._stop.is_set() or id(queue) in self._senders:
                 return

@@ -59,6 +59,11 @@ def main(argv=None) -> int:
         "(plus the wire-protocol pair); the incremental pre-commit mode",
     )
     ap.add_argument(
+        "--model", action="store_true",
+        help="run the bounded protocol model checker (full profile) + "
+        "drift gate instead of the checker registry",
+    )
+    ap.add_argument(
         "--checker", action="append", metavar="NAME",
         help="run only this checker (repeatable; see --list)",
     )
@@ -83,6 +88,16 @@ def main(argv=None) -> int:
     if args.json and args.sarif:
         print("error: --json and --sarif are exclusive", file=sys.stderr)
         return 2
+    if args.model:
+        if args.paths or args.changed or args.checker or args.sarif:
+            print(
+                "error: --model runs the model layer alone (no paths/"
+                "--changed/--checker/--sarif)", file=sys.stderr,
+            )
+            return 2
+        from psana_ray_tpu.lint.model.checker import main_model
+
+        return main_model(json_mode=args.json)
     # a typo'd explicit path is a USAGE error (exit 2), never exit 1 —
     # CI reads 1 as "findings present" and must not misread a typo as one
     missing = [p for p in args.paths if not pathlib.Path(p).exists()]

@@ -20,6 +20,9 @@ from psana_ray_tpu.lint.checkers import (  # noqa: F401  (import = register)
     telemetry,
     threads,
     wire,
+    wiretaint,
 )
 # the flow layer (ISSUE 10) registers through the same import contract
 import psana_ray_tpu.lint.flow  # noqa: F401,E402  (import = register)
+# the model layer (ISSUE 18) likewise: drift gate + bounded exploration
+import psana_ray_tpu.lint.model.checker  # noqa: F401,E402  (import = register)

@@ -154,9 +154,14 @@ def default_target_files() -> List[pathlib.Path]:
 # - infeed/batcher.py + infeed/fanin.py: blocking-hot-path's drain-loop
 #   roots live there, and its root-resolution rot guard (rightly)
 #   refuses to run silently uncovered on a >10-file scan
+# - transport/workers.py: the ISSUE 17 worker-adoption handshake
+#   replays opcodes ('M'/tenant/codec ctx over SCM_RIGHTS) into _on_op;
+#   a scan that sees the dispatch table without the adoption plane (or
+#   vice versa) reads adopted ops as dead dispatch
 PROTOCOL_COMPANIONS = (
     "psana_ray_tpu/transport/tcp.py",
     "psana_ray_tpu/transport/evloop.py",
+    "psana_ray_tpu/transport/workers.py",
     "psana_ray_tpu/cluster/replication.py",
 )
 INCREMENTAL_COMPANIONS = PROTOCOL_COMPANIONS + (

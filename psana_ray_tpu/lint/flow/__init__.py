@@ -15,14 +15,18 @@ flow layer on top of the same parse-once ProjectIndex:
   at every shared-attribute access, no annotations required;
 - :mod:`resource` — ``resource-flow``: interprocedural acquire→release
   tracking along exception edges (the raise-between-acquire-and-
-  hand-off class).
+  hand-off class);
+- :mod:`order` — ``lock-order``: static lock-acquisition-order graph
+  over the call graph; cycles are deadlock findings, ``# lock-order:``
+  annotations are checked assertions.
 
-Importing this package registers the three checkers in the framework
+Importing this package registers the four checkers in the framework
 registry, exactly like :mod:`psana_ray_tpu.lint.checkers`.
 """
 
 from psana_ray_tpu.lint.flow import (  # noqa: F401  (import = register)
     lockset,
+    order,
     protocol,
     resource,
 )

@@ -344,6 +344,9 @@ class ServingGateway:
         Serialized: concurrent callers (a run() thread racing a
         drain()) queue behind ``_dispatch_serial``, so the dispatch
         callable is never re-entered."""
+        # lock-order: ServingGateway._dispatch_serial -> ServingGateway._lock
+        # (the serial gate is always the outer lock; _lock-holding paths
+        # never wait on the gate)
         with self._dispatch_serial:
             return self._dispatch_once_locked(now)
 

@@ -1,0 +1,46 @@
+"""known-bad: the SIGUSR2-dump lock-order cycle (PR 4 class).
+
+The dump path iterates the registry under the registry lock and
+snapshots each connection under the connection lock; the connection
+close path nests the same two locks the other way around.  One SIGUSR2
+while a connection is closing and both threads sleep forever.
+"""
+
+import threading
+
+
+class ConnRegistry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._conns = []
+
+    def register(self, conn):
+        with self._reg_lock:
+            self._conns.append(conn)
+
+    def dump_all(self):
+        # BUG: registry lock outside, connection lock inside ...
+        with self._reg_lock:
+            lines = []
+            for conn in self._conns:
+                with conn._conn_lock:
+                    lines.append(conn.describe())
+            return lines
+
+
+class Conn:
+    def __init__(self, registry):
+        self.registry = registry
+        self._conn_lock = threading.Lock()
+        self.open = True
+
+    def describe(self):
+        return "conn open=%s" % self.open
+
+    def close(self):
+        # ... while close nests them the other way: deadlock with a
+        # concurrent dump_all
+        with self._conn_lock:
+            self.open = False
+            with self.registry._reg_lock:
+                self.registry._conns.remove(self)

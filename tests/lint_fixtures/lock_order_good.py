@@ -1,0 +1,49 @@
+"""sanctioned: the same two locks with ONE global order, declared.
+
+Every path nests registry-before-connection; the ``# lock-order:``
+annotation turns the convention into a checked assertion.  The close
+path drops to a snapshot-then-act shape instead of nesting backwards.
+"""
+
+import threading
+
+
+class ConnRegistry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._conns = []
+
+    def register(self, conn):
+        with self._reg_lock:
+            self._conns.append(conn)
+
+    def unregister(self, conn):
+        with self._reg_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def dump_all(self):
+        # lock-order: ConnRegistry._reg_lock -> Conn._conn_lock
+        with self._reg_lock:
+            lines = []
+            for conn in self._conns:
+                with conn._conn_lock:
+                    lines.append(conn.describe())
+            return lines
+
+
+class Conn:
+    def __init__(self, registry):
+        self.registry = registry
+        self._conn_lock = threading.Lock()
+        self.open = True
+
+    def describe(self):
+        return "conn open=%s" % self.open
+
+    def close(self):
+        # mark closed under the connection lock, THEN unregister with no
+        # lock held — the declared order is never contradicted
+        with self._conn_lock:
+            self.open = False
+        self.registry.unregister(self)

@@ -1,0 +1,49 @@
+"""known-bad: a wire surface with an opcode the model fleet ignores.
+
+``_OP_FROB`` is dispatched by the server but no protocol model declares
+it and ``lint.model.drift.NON_MODELED`` carries no justification — the
+drift gate must flag the blind spot (this is the "added an opcode to
+the transport without modeling it" class).
+"""
+
+_OP_PUT_SEQ = b"W"
+_OP_FROB = b"f"
+_ST_OK = b"1"
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("eof")
+        buf += chunk
+    return buf
+
+
+class FrobServerConn:
+    def __init__(self, sock, queue):
+        self._sock = sock
+        self.queue = queue
+
+    def _dispatch(self):
+        op = _recv_exact(self._sock, 1)[0]
+        name = _OPS.get(op)
+        if name is None:
+            raise ConnectionError("unknown opcode")
+        getattr(self, name)()
+
+    def _op_put_seq(self):
+        item = _recv_exact(self._sock, 12)
+        self.queue.put(item)
+        self._sock.sendall(_ST_OK)
+
+    def _op_frob(self):
+        # a whole new stateful exchange, invisible to the model fleet
+        self._sock.sendall(_ST_OK)
+
+
+_OPS = {
+    _OP_PUT_SEQ[0]: "_op_put_seq",
+    _OP_FROB[0]: "_op_frob",
+}

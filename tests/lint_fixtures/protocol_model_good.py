@@ -1,0 +1,50 @@
+"""sanctioned: a wire surface the model fleet fully accounts for.
+
+Both dispatched opcodes are covered — ``_OP_PUT_SEQ`` by the windowed
+model, ``_OP_PUT`` by a written ``NON_MODELED`` justification — so the
+drift gate has nothing to say.  (The model->code direction only runs
+against the real transport, never against fixture-sized protocols.)
+"""
+
+_OP_PUT_SEQ = b"W"
+_OP_PUT = b"P"
+_ST_OK = b"1"
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("eof")
+        buf += chunk
+    return buf
+
+
+class CoveredServerConn:
+    def __init__(self, sock, queue):
+        self._sock = sock
+        self.queue = queue
+
+    def _dispatch(self):
+        op = _recv_exact(self._sock, 1)[0]
+        name = _OPS.get(op)
+        if name is None:
+            raise ConnectionError("unknown opcode")
+        getattr(self, name)()
+
+    def _op_put_seq(self):
+        item = _recv_exact(self._sock, 12)
+        self.queue.put(item)
+        self._sock.sendall(_ST_OK)
+
+    def _op_put(self):
+        item = _recv_exact(self._sock, 4)
+        self.queue.put(item)
+        self._sock.sendall(_ST_OK)
+
+
+_OPS = {
+    _OP_PUT_SEQ[0]: "_op_put_seq",
+    _OP_PUT[0]: "_op_put",
+}

@@ -1,0 +1,38 @@
+"""known-bad: wire-parsed sizes reaching allocation sinks unchecked.
+
+The PR 9 class: a hostile peer picks the RLE count / payload length and
+the server allocates whatever it says — ``np.repeat`` amplification,
+frame-buffer ``bytearray``, pool lease sizing and zero-fill
+amplification, all straight from ``struct.unpack`` with no cap.
+"""
+
+import struct
+
+import numpy as np
+
+
+def decode_rle(buf, values):
+    (count,) = struct.unpack_from("<I", buf, 0)
+    # BUG: count is attacker-chosen; repeat amplifies a 4-byte field
+    # into count elements
+    return np.repeat(values, count)
+
+
+def read_frame(sock, hdr):
+    size, flags = struct.unpack("<QH", hdr)
+    # BUG: a 64-bit length allocates before any sanity check
+    payload = bytearray(size)
+    sock.recv_into(payload)
+    return payload, flags
+
+
+def lease_for(pool, hdr):
+    n = struct.unpack_from("<I", hdr)[0]
+    # BUG: pool lease sized by the unchecked wire field
+    return pool.lease(n)
+
+
+def zero_fill(hdr):
+    (n,) = struct.unpack("<I", hdr)
+    # BUG: bytes amplification from a 4-byte field
+    return b"\x00" * n

@@ -1,0 +1,42 @@
+"""sanctioned: the same wire parses with bounds enforced first.
+
+Every size parsed off the wire passes an explicit cap (raise on
+oversize) or a ``min()`` clamp before it sizes anything; u16-width
+fields are structurally bounded and need no check.
+"""
+
+import struct
+
+import numpy as np
+
+_MAX_RLE = 1 << 20
+_MAX_FRAME = 256 << 20
+_MAX_LEASE = 64 << 20
+
+
+def decode_rle(buf, values):
+    (count,) = struct.unpack_from("<I", buf, 0)
+    if count > _MAX_RLE:
+        raise ValueError("rle count exceeds decode cap")
+    return np.repeat(values, count)
+
+
+def read_frame(sock, hdr):
+    size, flags = struct.unpack("<QH", hdr)
+    if size > _MAX_FRAME:
+        raise ValueError("frame exceeds wire cap")
+    payload = bytearray(size)
+    sock.recv_into(payload)
+    return payload, flags
+
+
+def lease_for(pool, hdr):
+    n = struct.unpack_from("<I", hdr)[0]
+    n = min(n, _MAX_LEASE)
+    return pool.lease(n)
+
+
+def name_buf(hdr):
+    # u16 length: structurally capped at 64 KiB, no check required
+    (n,) = struct.unpack("<H", hdr)
+    return bytearray(n)

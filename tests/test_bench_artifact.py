@@ -201,6 +201,24 @@ def test_compare_baseline_flags_regressed_key_rows():
     assert by_key["replication_kill_lost"]["rule"] == "lost_frames"
 
 
+def test_compare_baseline_model_counterexamples_zero_tolerance():
+    # ISSUE 18: one counterexample is a protocol bug, not noise — and a
+    # fleet that stopped exhausting its bounds proves nothing
+    baseline = {"lint": {"model": {"counterexamples": 0,
+                                   "exhausted_all": True,
+                                   "states": 1917}}}
+    current = {"lint": {"model": {"counterexamples": 1,
+                                  "exhausted_all": False,
+                                  "states": 1917}}}
+    by_key = {r["key"]: r for r in bench.compare_baseline(current, baseline)}
+    assert by_key["lint.model.counterexamples"]["rule"] == \
+        "model_counterexamples"
+    assert by_key["lint.model.exhausted_all"]["rule"] == "model_exhausted"
+    # states is informational, not gated
+    assert "lint.model.states" not in by_key
+    assert bench.compare_baseline(dict(baseline), dict(baseline)) == []
+
+
 def test_compare_baseline_clean_pair_is_empty():
     art = {"host_passthrough_fps": 100.0, "value": 5.0,
            "serving": {"gateway_p99_ms": 290.0}}
